@@ -1,0 +1,25 @@
+"""deeplearning4j_tpu — a TPU-native deep learning framework with the
+capabilities of Deeplearning4J (reference: kuonanhong/deeplearning4j),
+rebuilt idiomatically on JAX/XLA/Pallas.
+
+Public surface mirrors the reference's behavioral API (config-builder DSL,
+MultiLayerNetwork / ComputationGraph lifecycle, zoo models, evaluation,
+checkpointing, data-parallel scale-out) on a functional, jit-compiled,
+pjit-sharded core.
+"""
+
+from .nn.conf.builders import (BackpropType, MultiLayerConfiguration,
+                               NeuralNetConfiguration, OptimizationAlgorithm)
+from .nn.conf.inputs import InputType
+from .nn.layers.core import (ActivationLayer, DenseLayer, DropoutLayer,
+                             EmbeddingLayer, LossLayer, OutputLayer)
+from .nn.multilayer import MultiLayerNetwork
+from .nn.updaters import (Adam, AdaDelta, AdaGrad, AdaMax, GradientNormalization,
+                          Nesterovs, NoOp, RmsProp, Sgd)
+from .nn.weights import Distribution, WeightInit
+from .data.dataset import DataSet, MultiDataSet
+from .data.iterators import (AsyncDataSetIterator, DataSetIterator,
+                             ExistingDataSetIterator, ListDataSetIterator)
+from .eval.evaluation import Evaluation, EvaluationBinary, RegressionEvaluation
+
+__version__ = "0.1.0"

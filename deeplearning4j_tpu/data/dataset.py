@@ -1,0 +1,90 @@
+"""DataSet / MultiDataSet containers.
+
+Reference parity: nd4j-api `DataSet` (features, labels, featuresMask,
+labelsMask) and `MultiDataSet` (arrays of each), consumed by every fit loop
+(MultiLayerNetwork.java:1059-1095, ComputationGraph.java:867).
+
+TPU-native: thin dataclasses over numpy/jax arrays. Host-side data stays
+numpy (cheap slicing/shuffling); transfer to device happens at the jit
+boundary of the training step.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+@dataclass
+class DataSet:
+    features: np.ndarray
+    labels: np.ndarray
+    features_mask: Optional[np.ndarray] = None
+    labels_mask: Optional[np.ndarray] = None
+
+    def num_examples(self) -> int:
+        return int(self.features.shape[0])
+
+    def split_test_and_train(self, n_train: int):
+        return (DataSet(self.features[:n_train], self.labels[:n_train],
+                        _sl(self.features_mask, 0, n_train),
+                        _sl(self.labels_mask, 0, n_train)),
+                DataSet(self.features[n_train:], self.labels[n_train:],
+                        _sl(self.features_mask, n_train, None),
+                        _sl(self.labels_mask, n_train, None)))
+
+    def shuffle(self, seed: Optional[int] = None) -> "DataSet":
+        rng = np.random.default_rng(seed)
+        idx = rng.permutation(self.num_examples())
+        return DataSet(self.features[idx], self.labels[idx],
+                       None if self.features_mask is None else self.features_mask[idx],
+                       None if self.labels_mask is None else self.labels_mask[idx])
+
+    def batch_by(self, batch_size: int) -> List["DataSet"]:
+        out = []
+        for i in range(0, self.num_examples(), batch_size):
+            out.append(DataSet(
+                self.features[i:i + batch_size], self.labels[i:i + batch_size],
+                _sl(self.features_mask, i, i + batch_size),
+                _sl(self.labels_mask, i, i + batch_size)))
+        return out
+
+    @staticmethod
+    def merge(datasets: Sequence["DataSet"]) -> "DataSet":
+        return DataSet(
+            np.concatenate([d.features for d in datasets]),
+            np.concatenate([d.labels for d in datasets]),
+            _cat([d.features_mask for d in datasets]),
+            _cat([d.labels_mask for d in datasets]))
+
+
+def _sl(arr, a, b):
+    return None if arr is None else arr[a:b]
+
+
+def _cat(arrs):
+    if any(a is None for a in arrs):
+        return None
+    return np.concatenate(arrs)
+
+
+@dataclass
+class MultiDataSet:
+    """Multi-input/multi-output container (reference nd4j MultiDataSet),
+    consumed by ComputationGraph.fit."""
+
+    features: List[np.ndarray] = field(default_factory=list)
+    labels: List[np.ndarray] = field(default_factory=list)
+    features_masks: Optional[List[Optional[np.ndarray]]] = None
+    labels_masks: Optional[List[Optional[np.ndarray]]] = None
+
+    def num_examples(self) -> int:
+        return int(self.features[0].shape[0])
+
+    @staticmethod
+    def from_dataset(ds: DataSet) -> "MultiDataSet":
+        return MultiDataSet(
+            [ds.features], [ds.labels],
+            None if ds.features_mask is None else [ds.features_mask],
+            None if ds.labels_mask is None else [ds.labels_mask])

@@ -1,0 +1,288 @@
+"""DataSet iterators + async host-side prefetch.
+
+Reference parity: nd4j `DataSetIterator` SPI and DL4J's iterator stack —
+`ExistingDataSetIterator`, `ListDataSetIterator`, `IteratorDataSetIterator`,
+`MultipleEpochsIterator`, and the async prefetch wrappers
+`AsyncDataSetIterator` / `AsyncMultiDataSetIterator` (deeplearning4j-nn
+datasets/iterator/AsyncDataSetIterator.java — background prefetch thread +
+LinkedBlockingQueue) that every fit() transparently wraps
+(MultiLayerNetwork.java:1024).
+
+TPU-native: iterators produce host-side numpy DataSets; AsyncDataSetIterator
+runs a Python producer thread with a bounded queue so host ETL overlaps with
+device compute (the jit dispatch is async, so the device pipeline stays full —
+the role the reference's prefetch thread plays for GPU).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterable, Iterator, List, Optional
+
+import numpy as np
+
+from .dataset import DataSet, MultiDataSet
+
+
+class DataSetIterator:
+    """Iterator SPI (reference nd4j DataSetIterator). Subclasses implement
+    `reset` and `__next__`; `__iter__` restarts by default."""
+
+    def __iter__(self) -> Iterator[DataSet]:
+        self.reset()
+        return self
+
+    def __next__(self) -> DataSet:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        pass
+
+    def batch_size(self) -> int:
+        raise NotImplementedError
+
+    def total_examples(self) -> Optional[int]:
+        return None
+
+    def async_supported(self) -> bool:
+        return True
+
+    # Normalizer hook (reference DataSetIterator.setPreProcessor)
+    pre_processor: Optional[Callable[[DataSet], DataSet]] = None
+
+    def _maybe_preprocess(self, ds: DataSet) -> DataSet:
+        if self.pre_processor is not None:
+            out = self.pre_processor(ds)
+            return ds if out is None else out
+        return ds
+
+
+class ListDataSetIterator(DataSetIterator):
+    """Iterate a list of examples in minibatches (reference
+    ListDataSetIterator)."""
+
+    def __init__(self, data: DataSet, batch_size: int = 32, shuffle: bool = False,
+                 seed: Optional[int] = None, drop_last: bool = False):
+        self._data = data
+        self._batch = int(batch_size)
+        self._shuffle = shuffle
+        self._seed = seed
+        self._epoch = 0
+        self._drop_last = drop_last
+        self._cursor = 0
+        self._view = data
+
+    def reset(self):
+        self._cursor = 0
+        if self._shuffle:
+            self._view = self._data.shuffle(
+                None if self._seed is None else self._seed + self._epoch)
+            self._epoch += 1
+
+    def __next__(self) -> DataSet:
+        n = self._view.num_examples()
+        if self._cursor >= n:
+            raise StopIteration
+        end = min(self._cursor + self._batch, n)
+        if self._drop_last and end - self._cursor < self._batch:
+            raise StopIteration
+        ds = DataSet(self._view.features[self._cursor:end],
+                     self._view.labels[self._cursor:end],
+                     None if self._view.features_mask is None
+                     else self._view.features_mask[self._cursor:end],
+                     None if self._view.labels_mask is None
+                     else self._view.labels_mask[self._cursor:end])
+        self._cursor = end
+        return self._maybe_preprocess(ds)
+
+    def batch_size(self):
+        return self._batch
+
+    def total_examples(self):
+        return self._data.num_examples()
+
+
+class ExistingDataSetIterator(DataSetIterator):
+    """Wrap an existing iterable of DataSets (reference
+    ExistingDataSetIterator)."""
+
+    def __init__(self, datasets: Iterable[DataSet]):
+        self._datasets = list(datasets)
+        self._i = 0
+
+    def reset(self):
+        self._i = 0
+
+    def __next__(self):
+        if self._i >= len(self._datasets):
+            raise StopIteration
+        ds = self._datasets[self._i]
+        self._i += 1
+        return self._maybe_preprocess(ds)
+
+    def batch_size(self):
+        return self._datasets[0].num_examples() if self._datasets else 0
+
+
+class MultipleEpochsIterator(DataSetIterator):
+    """Replay an iterator for N epochs as one pass (reference
+    MultipleEpochsIterator)."""
+
+    def __init__(self, epochs: int, base: DataSetIterator):
+        self._epochs = int(epochs)
+        self._base = base
+        self._epoch = 0
+        self._inner: Optional[Iterator] = None
+
+    def reset(self):
+        self._epoch = 0
+        self._inner = None
+
+    def __next__(self):
+        while True:
+            if self._inner is None:
+                if self._epoch >= self._epochs:
+                    raise StopIteration
+                self._base.reset()
+                self._inner = iter(self._base)
+                self._epoch += 1
+            try:
+                return next(self._inner)
+            except StopIteration:
+                self._inner = None
+
+    def batch_size(self):
+        return self._base.batch_size()
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Background-thread prefetch with a bounded queue (reference
+    datasets/iterator/AsyncDataSetIterator.java). `queue_size` mirrors the
+    reference's buffer size (default 8)."""
+
+    _SENTINEL = object()
+
+    def __init__(self, base: DataSetIterator, queue_size: int = 8):
+        self._base = base
+        self._queue_size = max(1, int(queue_size))
+        self._queue: Optional[queue.Queue] = None
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._shutdown = threading.Event()
+
+    def _producer(self, q: queue.Queue):
+        try:
+            for ds in self._base:
+                if self._shutdown.is_set():
+                    return
+                q.put(ds)
+            q.put(self._SENTINEL)
+        except BaseException as e:  # propagate to consumer
+            self._error = e
+            q.put(self._SENTINEL)
+
+    def reset(self):
+        self._stop_thread()
+        self._shutdown.clear()
+        self._error = None
+        self._queue = queue.Queue(maxsize=self._queue_size)
+        self._thread = threading.Thread(
+            target=self._producer, args=(self._queue,), daemon=True)
+        self._thread.start()
+
+    def _stop_thread(self):
+        if self._thread is not None and self._thread.is_alive():
+            self._shutdown.set()
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=5.0)
+        self._thread = None
+
+    def __iter__(self):
+        self.reset()
+        return self
+
+    def __next__(self):
+        if self._queue is None:
+            self.reset()
+        item = self._queue.get()
+        if item is self._SENTINEL:
+            self._thread = None
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise err
+            raise StopIteration
+        return item
+
+    def batch_size(self):
+        return self._base.batch_size()
+
+    def shutdown(self):
+        self._stop_thread()
+
+
+class IteratorDataSetIterator(DataSetIterator):
+    """Re-batch a stream of DataSets to a fixed minibatch size (reference
+    IteratorDataSetIterator, used by the Spark worker loop)."""
+
+    def __init__(self, base: Iterable[DataSet], batch_size: int):
+        self._base_iterable = base
+        self._batch = int(batch_size)
+        self._iter: Optional[Iterator[DataSet]] = None
+        self._buffer: List[DataSet] = []
+        self._buffered = 0
+
+    def reset(self):
+        self._iter = iter(self._base_iterable)
+        self._buffer = []
+        self._buffered = 0
+
+    def __next__(self) -> DataSet:
+        if self._iter is None:
+            self.reset()
+        while self._buffered < self._batch:
+            try:
+                ds = next(self._iter)
+            except StopIteration:
+                break
+            self._buffer.append(ds)
+            self._buffered += ds.num_examples()
+        if not self._buffer:
+            raise StopIteration
+        merged = DataSet.merge(self._buffer)
+        out = DataSet(merged.features[:self._batch], merged.labels[:self._batch],
+                      None if merged.features_mask is None
+                      else merged.features_mask[:self._batch],
+                      None if merged.labels_mask is None
+                      else merged.labels_mask[:self._batch])
+        rest = merged.features.shape[0] - self._batch
+        if rest > 0:
+            self._buffer = [DataSet(
+                merged.features[self._batch:], merged.labels[self._batch:],
+                None if merged.features_mask is None
+                else merged.features_mask[self._batch:],
+                None if merged.labels_mask is None
+                else merged.labels_mask[self._batch:])]
+            self._buffered = rest
+        else:
+            self._buffer = []
+            self._buffered = 0
+        return out
+
+    def batch_size(self):
+        return self._batch
+
+
+def as_iterator(data, labels=None, batch_size: int = 32) -> DataSetIterator:
+    """Coerce (features, labels) / DataSet / iterator to a DataSetIterator."""
+    if isinstance(data, DataSetIterator):
+        return data
+    if isinstance(data, DataSet):
+        return ListDataSetIterator(data, batch_size or data.num_examples())
+    if labels is None:
+        raise ValueError("labels required when passing a raw feature array")
+    ds = DataSet(np.asarray(data), np.asarray(labels))
+    return ListDataSetIterator(ds, batch_size or ds.num_examples())

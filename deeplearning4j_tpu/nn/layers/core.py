@@ -1,0 +1,278 @@
+"""Core layer abstraction + feed-forward layers.
+
+Reference parity: DL4J splits every layer into a config class
+(nn/conf/layers/*.java) and a runtime impl (nn/layers/**) wired through the
+Layer SPI (nn/api/Layer.java:119 — `activate`, `backpropGradient`) plus a
+ParamInitializer (nn/params/*.java) writing into a flat param buffer.
+
+TPU-native redesign: one dataclass per layer carrying BOTH the serializable
+config and the pure functional math:
+
+    params            = layer.init_params(key, dtype)     # dict of named arrays
+    state             = layer.init_state(dtype)           # e.g. BN running stats
+    y, new_state      = layer.forward(params, state, x, train=..., rng=..., mask=...)
+
+There is no backpropGradient — jax.grad differentiates the whole composed
+forward (the reference's per-layer hand-written backward passes exist because
+ND4J has no autodiff). There is no flat param buffer — params are pytrees and
+XLA handles memory layout; `utils.params.flatten_params` provides the flat
+view for checkpoints (coefficients.bin analog) and parity tooling.
+
+Dropout follows the reference semantics: applied to the layer's INPUT during
+training, inverted scaling (nn/conf/layers/Layer.java `dropOut`,
+util/Dropout.java).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...ops import activations as act_ops
+from ...ops import losses as loss_ops
+from ...utils import serde
+from ..conf.inputs import (ConvolutionalType, FeedForwardType, InputType,
+                           RecurrentType)
+from ..updaters import GradientNormalization, Updater
+from ..weights import Distribution, WeightInit, init_weights
+
+Array = jax.Array
+Params = Dict[str, Array]
+State = Dict[str, Array]
+
+# Parameter-type tags (reference: DefaultParamInitializer.WEIGHT_KEY/BIAS_KEY);
+# used to route per-param-type regularization and gradient normalization.
+WEIGHT = "W"
+BIAS = "b"
+
+
+def dropout(x: Array, rate: float, train: bool, rng: Optional[Array]) -> Array:
+    """Inverted dropout on layer input (reference util/Dropout.java)."""
+    if not train or rate is None or rate <= 0.0:
+        return x
+    if rng is None:
+        raise ValueError("Dropout requires an rng key during training")
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0)
+
+
+@serde.register
+@dataclass
+class Layer:
+    """Base config for all layers. Fields default to None = 'inherit the
+    global default from NeuralNetConfiguration.Builder' (the reference's
+    config-merging in nn/conf/layers/Layer.Builder)."""
+
+    name: Optional[str] = None
+    activation: Optional[str] = None
+    weight_init: Optional[WeightInit] = None
+    dist: Optional[Distribution] = None
+    bias_init: Optional[float] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    l1_bias: Optional[float] = None
+    l2_bias: Optional[float] = None
+    dropout_rate: Optional[float] = None
+    updater: Optional[Updater] = None
+    gradient_normalization: Optional[GradientNormalization] = None
+    gradient_normalization_threshold: float = 1.0
+    frozen: bool = False  # transfer-learning freeze (reference FrozenLayer)
+
+    # ---- shape inference -------------------------------------------------
+    def input_kind(self) -> str:
+        """Expected input family: 'ff' | 'cnn' | 'rnn' | 'any'. Drives
+        automatic preprocessor insertion (reference InputTypeUtil /
+        Layer.getPreProcessorForInputType)."""
+        return "ff"
+
+    def set_input_type(self, input_type: InputType) -> InputType:
+        """Bind input shape (infer n_in etc.); return this layer's output
+        type. Reference: Layer.setNIn + getOutputType in nn/conf/layers."""
+        return input_type
+
+    # ---- params/state ----------------------------------------------------
+    def init_params(self, key: Array, dtype=jnp.float32) -> Params:
+        return {}
+
+    def init_state(self, dtype=jnp.float32) -> State:
+        return {}
+
+    def has_params(self) -> bool:
+        return False
+
+    def param_reg(self, pname: str) -> Tuple[float, float]:
+        """(l1, l2) applied to the named parameter."""
+        if pname == BIAS:
+            return (self.l1_bias or 0.0, self.l2_bias or 0.0)
+        if pname == WEIGHT:
+            return (self.l1 or 0.0, self.l2 or 0.0)
+        return (0.0, 0.0)
+
+    # ---- forward ---------------------------------------------------------
+    def forward(self, params: Params, state: State, x: Array, *,
+                train: bool = False, rng: Optional[Array] = None,
+                mask: Optional[Array] = None) -> Tuple[Array, State]:
+        raise NotImplementedError
+
+    # ---- helpers ---------------------------------------------------------
+    def _act(self):
+        return act_ops.resolve(self.activation)
+
+    def is_output_layer(self) -> bool:
+        return False
+
+    def is_recurrent(self) -> bool:
+        return False
+
+    def _winit(self, key, shape, fan_in, fan_out, dtype):
+        return init_weights(key, shape, fan_in, fan_out,
+                            self.weight_init or WeightInit.XAVIER,
+                            self.dist, dtype)
+
+
+@serde.register
+@dataclass
+class DenseLayer(Layer):
+    """Fully connected layer (reference nn/conf/layers/DenseLayer +
+    nn/layers/feedforward/dense/DenseLayer: z = xW + b, a = act(z))."""
+
+    n_in: int = 0
+    n_out: int = 0
+
+    def set_input_type(self, input_type):
+        if isinstance(input_type, FeedForwardType):
+            if self.n_in == 0:
+                self.n_in = input_type.size
+        else:
+            raise ValueError(f"DenseLayer needs FeedForward input, got {input_type}")
+        return FeedForwardType(size=self.n_out)
+
+    def has_params(self):
+        return True
+
+    def init_params(self, key, dtype=jnp.float32):
+        w = self._winit(key, (self.n_in, self.n_out), self.n_in, self.n_out, dtype)
+        b = jnp.full((self.n_out,), self.bias_init or 0.0, dtype)
+        return {WEIGHT: w, BIAS: b}
+
+    def preout(self, params, x):
+        return x @ params[WEIGHT] + params[BIAS]
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = dropout(x, self.dropout_rate, train, rng)
+        return self._act()(self.preout(params, x)), state
+
+
+@serde.register
+@dataclass
+class EmbeddingLayer(Layer):
+    """Lookup layer: integer indices → rows of W (reference
+    nn/conf/layers/EmbeddingLayer + nn/layers/feedforward/embedding).
+    Input is [batch] or [batch, 1] int indices (the reference takes a one-hot
+    column); gather is the TPU-native op."""
+
+    n_in: int = 0  # vocabulary size
+    n_out: int = 0
+
+    def set_input_type(self, input_type):
+        if isinstance(input_type, FeedForwardType) and self.n_in == 0:
+            self.n_in = input_type.size
+        return FeedForwardType(size=self.n_out)
+
+    def has_params(self):
+        return True
+
+    def init_params(self, key, dtype=jnp.float32):
+        w = self._winit(key, (self.n_in, self.n_out), self.n_in, self.n_out, dtype)
+        b = jnp.full((self.n_out,), self.bias_init or 0.0, dtype)
+        return {WEIGHT: w, BIAS: b}
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        idx = x.astype(jnp.int32)
+        if idx.ndim == 2 and idx.shape[-1] == 1:
+            idx = idx[:, 0]
+        out = jnp.take(params[WEIGHT], idx, axis=0) + params[BIAS]
+        return self._act()(out), state
+
+
+@serde.register
+@dataclass
+class ActivationLayer(Layer):
+    """Pure activation (reference nn/conf/layers/ActivationLayer)."""
+
+    def input_kind(self):
+        return "any"
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        return self._act()(x), state
+
+
+@serde.register
+@dataclass
+class DropoutLayer(Layer):
+    """Standalone dropout (reference nn/conf/layers/DropoutLayer)."""
+
+    def input_kind(self):
+        return "any"
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        return dropout(x, self.dropout_rate, train, rng), state
+
+
+@serde.register
+@dataclass
+class BaseOutputLayer(DenseLayer):
+    """Dense + loss head (reference nn/conf/layers/BaseOutputLayer,
+    nn/layers/BaseOutputLayer). `compute_score_array` is the per-example score
+    (reference computeScoreForExamples); loss gradients come from autodiff of
+    `compute_score`."""
+
+    loss: str = "mcxent"
+
+    def is_output_layer(self):
+        return True
+
+    def compute_score(self, params, x, labels, mask=None) -> Array:
+        pre = self.preout(params, x)
+        return loss_ops.resolve(self.loss).score(
+            labels, pre, self.activation or "identity", mask)
+
+    def compute_score_array(self, params, x, labels, mask=None) -> Array:
+        pre = self.preout(params, x)
+        return loss_ops.resolve(self.loss).score_array(
+            labels, pre, self.activation or "identity", mask)
+
+
+@serde.register
+@dataclass
+class OutputLayer(BaseOutputLayer):
+    pass
+
+
+@serde.register
+@dataclass
+class LossLayer(Layer):
+    """Parameterless loss head (reference nn/conf/layers/LossLayer): applies
+    activation + loss to its input without a weight matrix."""
+
+    loss: str = "mse"
+
+    def input_kind(self):
+        return "any"
+
+    def is_output_layer(self):
+        return True
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        return self._act()(x), state
+
+    def compute_score(self, params, x, labels, mask=None):
+        return loss_ops.resolve(self.loss).score(
+            labels, x, self.activation or "identity", mask)
+
+    def compute_score_array(self, params, x, labels, mask=None):
+        return loss_ops.resolve(self.loss).score_array(
+            labels, x, self.activation or "identity", mask)

@@ -1,0 +1,115 @@
+"""Weight initialization schemes.
+
+Reference parity: deeplearning4j-nn nn/weights/WeightInit.java +
+WeightInitUtil.java. Schemes: DISTRIBUTION, ZERO, SIGMOID_UNIFORM, UNIFORM,
+XAVIER, XAVIER_UNIFORM, XAVIER_FAN_IN, XAVIER_LEGACY, RELU, RELU_UNIFORM,
+plus layer-default biases. DL4J draws into a flat row-major buffer with a
+seeded RNG; here each parameter is drawn independently from a jax PRNG key
+split per-parameter (functional, reproducible, device-side).
+"""
+from __future__ import annotations
+
+import enum
+import math
+from dataclasses import dataclass
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..utils import serde
+
+Array = jax.Array
+
+
+@serde.register
+class WeightInit(enum.Enum):
+    DISTRIBUTION = "distribution"
+    ZERO = "zero"
+    ONES = "ones"
+    UNIFORM = "uniform"
+    SIGMOID_UNIFORM = "sigmoid_uniform"
+    XAVIER = "xavier"
+    XAVIER_UNIFORM = "xavier_uniform"
+    XAVIER_FAN_IN = "xavier_fan_in"
+    XAVIER_LEGACY = "xavier_legacy"
+    RELU = "relu"
+    RELU_UNIFORM = "relu_uniform"
+    LECUN_NORMAL = "lecun_normal"
+    LECUN_UNIFORM = "lecun_uniform"
+    NORMAL = "normal"
+
+
+@serde.register
+@dataclass
+class Distribution:
+    """Explicit distribution for WeightInit.DISTRIBUTION (reference
+    nn/conf/distribution/{Normal,Uniform,Binomial}Distribution)."""
+
+    kind: str = "normal"  # normal | uniform
+    mean: float = 0.0
+    std: float = 1.0
+    lower: float = -1.0
+    upper: float = 1.0
+
+    def sample(self, key: jax.Array, shape, dtype) -> Array:
+        if self.kind == "normal":
+            return self.mean + self.std * jax.random.normal(key, shape, dtype)
+        if self.kind == "uniform":
+            return jax.random.uniform(key, shape, dtype, self.lower, self.upper)
+        raise ValueError(f"Unknown distribution kind {self.kind!r}")
+
+
+def init_weights(
+    key: jax.Array,
+    shape: Sequence[int],
+    fan_in: int,
+    fan_out: int,
+    scheme: WeightInit,
+    distribution: Distribution | None = None,
+    dtype=jnp.float32,
+) -> Array:
+    """Draw one weight tensor (reference WeightInitUtil.initWeights)."""
+    shape = tuple(int(s) for s in shape)
+    s = scheme
+    if s == WeightInit.ZERO:
+        return jnp.zeros(shape, dtype)
+    if s == WeightInit.ONES:
+        return jnp.ones(shape, dtype)
+    if s == WeightInit.DISTRIBUTION:
+        if distribution is None:
+            raise ValueError("WeightInit.DISTRIBUTION requires a Distribution")
+        return distribution.sample(key, shape, dtype)
+    if s == WeightInit.UNIFORM:
+        a = 1.0 / math.sqrt(fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if s == WeightInit.SIGMOID_UNIFORM:
+        a = 4.0 * math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if s == WeightInit.XAVIER:
+        std = math.sqrt(2.0 / (fan_in + fan_out))
+        return std * jax.random.normal(key, shape, dtype)
+    if s == WeightInit.XAVIER_UNIFORM:
+        a = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if s == WeightInit.XAVIER_FAN_IN:
+        std = math.sqrt(1.0 / fan_in)
+        return std * jax.random.normal(key, shape, dtype)
+    if s == WeightInit.XAVIER_LEGACY:
+        std = math.sqrt(1.0 / (fan_in + fan_out))
+        return std * jax.random.normal(key, shape, dtype)
+    if s == WeightInit.RELU:
+        std = math.sqrt(2.0 / fan_in)
+        return std * jax.random.normal(key, shape, dtype)
+    if s == WeightInit.RELU_UNIFORM:
+        a = math.sqrt(6.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if s == WeightInit.LECUN_NORMAL:
+        std = math.sqrt(1.0 / fan_in)
+        return std * jax.random.normal(key, shape, dtype)
+    if s == WeightInit.LECUN_UNIFORM:
+        a = math.sqrt(3.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if s == WeightInit.NORMAL:
+        return jax.random.normal(key, shape, dtype)
+    raise ValueError(f"Unknown weight init scheme {scheme}")

@@ -1,0 +1,153 @@
+"""Activation functions.
+
+Reference parity: DL4J's IActivation implementations (external nd4j-api
+`org.nd4j.linalg.activations.Activation` enum, used throughout
+deeplearning4j-nn layer configs, e.g. nn/conf/layers/*.java `activationFn`).
+The reference set at 0.8.1: CUBE, ELU, HARDSIGMOID, HARDTANH, IDENTITY,
+LEAKYRELU, RATIONALTANH, RELU, RRELU, SIGMOID, SOFTMAX, SOFTPLUS, SOFTSIGN,
+TANH, RECTIFIEDTANH, SELU.
+
+TPU-native redesign: activations are pure jnp functions fused by XLA into the
+surrounding matmul (no hand-written derivative classes — autodiff supplies
+VJPs, replacing IActivation.backprop). Configs carry the string name so JSON
+round-trips; `resolve` turns name → fn at trace time.
+"""
+from __future__ import annotations
+
+from typing import Callable, Union
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _identity(x):
+    return x
+
+
+def _relu(x):
+    return jax.nn.relu(x)
+
+
+def _relu6(x):
+    return jnp.minimum(jax.nn.relu(x), 6.0)
+
+
+def _leakyrelu(x, alpha=0.01):
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+def _elu(x):
+    return jax.nn.elu(x)
+
+
+def _selu(x):
+    return jax.nn.selu(x)
+
+
+def _gelu(x):
+    return jax.nn.gelu(x)
+
+
+def _sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def _hardsigmoid(x):
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+def _tanh(x):
+    return jnp.tanh(x)
+
+
+def _hardtanh(x):
+    return jnp.clip(x, -1.0, 1.0)
+
+
+def _rationaltanh(x):
+    # tanh approximation 1.7159 * tanh(2x/3) (LeCun), as in nd4j RationalTanh.
+    a = jnp.abs(2.0 * x / 3.0)
+    approx = 1.0 - 1.0 / (1.0 + a + a * a + 1.41645 * a**4)
+    return 1.7159 * jnp.sign(x) * approx
+
+
+def _rectifiedtanh(x):
+    return jnp.maximum(0.0, jnp.tanh(x))
+
+
+def _softmax(x):
+    return jax.nn.softmax(x, axis=-1)
+
+
+def _logsoftmax(x):
+    return jax.nn.log_softmax(x, axis=-1)
+
+
+def _softplus(x):
+    return jax.nn.softplus(x)
+
+
+def _softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+def _cube(x):
+    return x * x * x
+
+
+def _swish(x):
+    return jax.nn.swish(x)
+
+
+def _mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+ACTIVATIONS: dict[str, Callable[[Array], Array]] = {
+    "identity": _identity,
+    "linear": _identity,
+    "relu": _relu,
+    "relu6": _relu6,
+    "leakyrelu": _leakyrelu,
+    "elu": _elu,
+    "selu": _selu,
+    "gelu": _gelu,
+    "sigmoid": _sigmoid,
+    "hardsigmoid": _hardsigmoid,
+    "tanh": _tanh,
+    "hardtanh": _hardtanh,
+    "rationaltanh": _rationaltanh,
+    "rectifiedtanh": _rectifiedtanh,
+    "softmax": _softmax,
+    "logsoftmax": _logsoftmax,
+    "softplus": _softplus,
+    "softsign": _softsign,
+    "cube": _cube,
+    "swish": _swish,
+    "mish": _mish,
+    # RRELU in the reference is randomized leaky-relu; deterministic alpha at
+    # inference. We map it to leakyrelu with the RReLU mean alpha (l+u)/2=0.25
+    # (divergence documented: no per-element random alpha during training).
+    "rrelu": lambda x: _leakyrelu(x, 0.25),
+}
+
+ActivationLike = Union[str, Callable[[Array], Array], None]
+
+
+def resolve(act: ActivationLike) -> Callable[[Array], Array]:
+    """Name-or-callable → callable. None means identity."""
+    if act is None:
+        return _identity
+    if callable(act):
+        return act
+    key = act.lower()
+    if key not in ACTIVATIONS:
+        raise ValueError(f"Unknown activation {act!r}. Known: {sorted(ACTIVATIONS)}")
+    return ACTIVATIONS[key]
+
+
+def register_activation(name: str, fn: Callable[[Array], Array]) -> None:
+    """Custom-activation extension point (reference: TestCustomActivation)."""
+    ACTIVATIONS[name.lower()] = fn

@@ -1,0 +1,48 @@
+"""Flat parameter views over pytree params.
+
+Reference parity: DL4J keeps ALL network parameters in one flat buffer with
+per-layer views (MultiLayerNetwork.java:442-536 init/initGradientsView;
+`params()` returns the flat vector). On TPU a flat buffer is an
+anti-optimization — XLA lays out each tensor for the MXU — so the pytree is
+the source of truth and these helpers materialize the flat view only at the
+API boundary (checkpointing = coefficients.bin analog, `net.params()`,
+parameter-averaging parity tests).
+
+Ordering contract: layer index order, then insertion order of each layer's
+param dict (W before b etc., matching each ParamInitializer's ordering),
+row-major ('C') flattening per tensor.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+def num_params(params: Any) -> int:
+    return sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
+
+
+def flatten_params(params: Any) -> Array:
+    leaves = jax.tree_util.tree_leaves(params)
+    if not leaves:
+        return jnp.zeros((0,), jnp.float32)
+    return jnp.concatenate([jnp.ravel(p) for p in leaves])
+
+
+def unflatten_params(template: Any, flat: Array) -> Any:
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+    out: List[Array] = []
+    offset = 0
+    for leaf in leaves:
+        n = int(np.prod(leaf.shape))
+        out.append(jnp.reshape(flat[offset:offset + n], leaf.shape).astype(leaf.dtype))
+        offset += n
+    if offset != flat.shape[0]:
+        raise ValueError(
+            f"Flat vector length {flat.shape[0]} != template size {offset}")
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -1,0 +1,24 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Mirrors the reference's "distributed without a cluster" strategy (Spark tests
+run local[N] in-JVM, BaseSparkTest.java:89): multi-chip sharding is exercised
+on N virtual CPU devices via --xla_force_host_platform_device_count, so the
+full tp/dp test matrix runs on any host. Real-TPU benchmarking happens via
+bench.py, not the test suite.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng_key():
+    return jax.random.PRNGKey(0)

@@ -1,0 +1,221 @@
+"""MultiLayerNetwork end-to-end tests.
+
+Reference analog: nn/multilayer/MultiLayerTest (fit on small data reaches a
+score threshold), nn/conf/NeuralNetConfigurationTest (JSON round-trip),
+gradientcheck/GradientCheckTests (finite differences vs backprop).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (Adam, DataSet, DenseLayer, Evaluation, InputType,
+                                ListDataSetIterator, MultiLayerConfiguration,
+                                MultiLayerNetwork, NeuralNetConfiguration,
+                                OutputLayer, Sgd, WeightInit)
+from deeplearning4j_tpu.utils.gradient_check import gradient_check_mln
+
+
+def make_iris_like(n=150, seed=0):
+    """Synthetic 3-class linearly-separable-ish data (Iris stand-in; the
+    reference tests use Iris via IrisDataSetIterator)."""
+    rng = np.random.default_rng(seed)
+    centers = np.array([[0, 0, 0, 0], [2, 2, 2, 2], [-2, 2, -2, 2]], np.float32)
+    xs, ys = [], []
+    for i in range(n):
+        c = i % 3
+        xs.append(centers[c] + rng.normal(0, 0.5, 4).astype(np.float32))
+        y = np.zeros(3, np.float32)
+        y[c] = 1
+        ys.append(y)
+    return DataSet(np.stack(xs), np.stack(ys))
+
+
+def mlp_conf(seed=42, updater=None, n_hidden=16):
+    return (NeuralNetConfiguration.builder()
+            .seed(seed)
+            .updater(updater or Adam(learning_rate=0.05))
+            .weight_init(WeightInit.XAVIER)
+            .list()
+            .layer(DenseLayer(n_out=n_hidden, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+
+
+class TestConfig:
+    def test_shape_inference(self):
+        conf = mlp_conf()
+        assert conf.layers[0].n_in == 4
+        assert conf.layers[1].n_in == 16
+
+    def test_json_roundtrip(self):
+        conf = mlp_conf()
+        s = conf.to_json()
+        conf2 = MultiLayerConfiguration.from_json(s)
+        assert conf2 == conf
+        # And the round-tripped config builds a working net
+        net = MultiLayerNetwork(conf2).init()
+        assert net.output(np.zeros((2, 4), np.float32)).shape == (2, 3)
+
+    def test_defaults_merged(self):
+        conf = (NeuralNetConfiguration.builder()
+                .activation("relu").l2(1e-4).updater(Sgd(0.2))
+                .list()
+                .layer(DenseLayer(n_out=8))
+                .layer(OutputLayer(n_out=3, activation="softmax"))
+                .set_input_type(InputType.feed_forward(4))
+                .build())
+        assert conf.layers[0].activation == "relu"
+        assert conf.layers[0].l2 == 1e-4
+        assert conf.layers[0].updater == Sgd(0.2)
+        # explicit layer setting wins over global
+        assert conf.layers[1].activation == "softmax"
+
+    def test_missing_layer_index_raises(self):
+        with pytest.raises(ValueError):
+            (NeuralNetConfiguration.builder().list()
+             .layer(0, DenseLayer(n_out=4))
+             .layer(2, OutputLayer(n_out=2)).build())
+
+
+class TestInitAndParams:
+    def test_param_count(self):
+        net = MultiLayerNetwork(mlp_conf()).init()
+        assert net.num_params() == (4 * 16 + 16) + (16 * 3 + 3)
+
+    def test_params_roundtrip(self):
+        net = MultiLayerNetwork(mlp_conf()).init()
+        flat = net.params()
+        net.set_params(flat * 0.0)
+        assert np.allclose(net.params(), 0.0)
+        net.set_params(flat)
+        assert np.allclose(net.params(), flat)
+
+    def test_deterministic_seed(self):
+        n1 = MultiLayerNetwork(mlp_conf(seed=7)).init()
+        n2 = MultiLayerNetwork(mlp_conf(seed=7)).init()
+        assert np.allclose(n1.params(), n2.params())
+
+
+class TestTraining:
+    def test_fit_reduces_score_and_learns(self):
+        data = make_iris_like()
+        net = MultiLayerNetwork(mlp_conf()).init()
+        s0 = net.score(data)
+        it = ListDataSetIterator(data, batch_size=32, shuffle=True, seed=1)
+        net.fit(it, epochs=30)
+        s1 = net.score(data)
+        assert s1 < s0 * 0.5
+        ev = net.evaluate(data)
+        assert ev.accuracy() > 0.9
+
+    def test_fit_arrays_api(self):
+        data = make_iris_like(60)
+        net = MultiLayerNetwork(mlp_conf()).init()
+        net.fit(data.features, data.labels, epochs=5, batch_size=16)
+        assert net.iteration > 0
+
+    def test_sgd_matches_manual_update(self):
+        # One SGD step must equal p - lr * grad exactly.
+        data = make_iris_like(30)
+        conf = mlp_conf(updater=Sgd(learning_rate=0.1))
+        net = MultiLayerNetwork(conf).init()
+        grads, _ = net.compute_gradient_and_score(data)
+        from deeplearning4j_tpu.utils.params import flatten_params
+        expected = net.params() - 0.1 * np.asarray(flatten_params(grads))
+        net.fit(data, epochs=1, batch_size=30, use_async=False)
+        np.testing.assert_allclose(net.params(), expected, rtol=1e-5, atol=1e-6)
+
+    def test_l2_shrinks_weights(self):
+        data = make_iris_like(30)
+        conf = (NeuralNetConfiguration.builder()
+                .updater(Sgd(0.1)).l2(0.5)
+                .list()
+                .layer(DenseLayer(n_out=8, activation="tanh"))
+                .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        w0 = np.abs(net.params()).sum()
+        net.fit(data, epochs=3, batch_size=30, use_async=False)
+        # strong l2 should keep weights small vs no-l2 run
+        conf2 = mlp_conf(updater=Sgd(0.1), n_hidden=8)
+        net2 = MultiLayerNetwork(conf2).init()
+        net2.fit(data, epochs=3, batch_size=30, use_async=False)
+        assert np.abs(net.params()).sum() < np.abs(net2.params()).sum()
+
+    def test_frozen_layer_not_updated(self):
+        data = make_iris_like(30)
+        conf = mlp_conf()
+        conf.layers[0].frozen = True
+        net = MultiLayerNetwork(conf).init()
+        w_before = np.array(net.params_tree[0]["W"])
+        net.fit(data, epochs=2, batch_size=30, use_async=False)
+        np.testing.assert_allclose(np.array(net.params_tree[0]["W"]), w_before)
+
+
+class TestInference:
+    def test_output_shape_and_predict(self):
+        net = MultiLayerNetwork(mlp_conf()).init()
+        x = np.random.default_rng(0).normal(size=(10, 4)).astype(np.float32)
+        out = net.output(x)
+        assert out.shape == (10, 3)
+        np.testing.assert_allclose(out.sum(-1), np.ones(10), rtol=1e-5)
+        assert net.predict(x).shape == (10,)
+
+    def test_feed_forward_returns_all_activations(self):
+        net = MultiLayerNetwork(mlp_conf()).init()
+        x = np.zeros((5, 4), np.float32)
+        acts = net.feed_forward(x)
+        assert len(acts) == 3  # input + 2 layers
+        assert acts[1].shape == (5, 16)
+        assert acts[2].shape == (5, 3)
+
+    def test_clone_predicts_same(self):
+        net = MultiLayerNetwork(mlp_conf()).init()
+        x = np.random.default_rng(1).normal(size=(4, 4)).astype(np.float32)
+        np.testing.assert_allclose(net.clone().output(x), net.output(x))
+
+
+class TestGradientCheck:
+    """The reference's load-bearing test family (GradientCheckTests)."""
+
+    @pytest.fixture(autouse=True)
+    def x64(self):
+        jax.config.update("jax_enable_x64", True)
+        yield
+        jax.config.update("jax_enable_x64", False)
+
+    def _check(self, conf, x, y, **kw):
+        net = MultiLayerNetwork(conf).init(dtype=jnp.float64)
+        assert gradient_check_mln(net, x.astype(np.float64),
+                                  y.astype(np.float64), **kw)
+
+    def test_mlp_mcxent(self):
+        data = make_iris_like(12)
+        self._check(mlp_conf(n_hidden=6), data.features, data.labels)
+
+    def test_mlp_mse_tanh(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(8, 4))
+        y = rng.normal(size=(8, 2))
+        conf = (NeuralNetConfiguration.builder()
+                .updater(Sgd(0.1))
+                .list()
+                .layer(DenseLayer(n_out=5, activation="sigmoid"))
+                .layer(OutputLayer(n_out=2, activation="tanh", loss="mse"))
+                .set_input_type(InputType.feed_forward(4))
+                .build())
+        self._check(conf, x, y)
+
+    def test_mlp_with_l1_l2(self):
+        data = make_iris_like(10)
+        conf = (NeuralNetConfiguration.builder()
+                .updater(Sgd(0.1)).l1(0.01).l2(0.02)
+                .list()
+                .layer(DenseLayer(n_out=5, activation="elu"))
+                .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4))
+                .build())
+        self._check(conf, data.features, data.labels)

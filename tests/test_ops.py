@@ -1,0 +1,192 @@
+"""Ops-level tests: activations, losses, weight init, updaters, serde.
+
+Reference analog: nd4j op correctness tests + DL4J's
+LossFunctionGradientCheck / TestUpdaters.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ops import activations, losses
+from deeplearning4j_tpu.nn import updaters
+from deeplearning4j_tpu.nn.weights import Distribution, WeightInit, init_weights
+from deeplearning4j_tpu.utils import serde
+
+
+class TestActivations:
+    def test_known_values(self):
+        x = jnp.array([-2.0, -0.5, 0.0, 0.5, 2.0])
+        np.testing.assert_allclose(activations.resolve("relu")(x),
+                                   [0, 0, 0, 0.5, 2.0])
+        np.testing.assert_allclose(activations.resolve("identity")(x), x)
+        np.testing.assert_allclose(activations.resolve("hardtanh")(x),
+                                   [-1, -0.5, 0, 0.5, 1])
+        np.testing.assert_allclose(activations.resolve("cube")(x),
+                                   x ** 3, rtol=1e-6)
+
+    def test_softmax_normalizes(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 10))
+        s = activations.resolve("softmax")(x)
+        np.testing.assert_allclose(np.sum(np.asarray(s), -1), np.ones(4), rtol=1e-6)
+
+    def test_all_registered_finite(self):
+        x = jnp.linspace(-3, 3, 64).reshape(8, 8)
+        for name in activations.ACTIVATIONS:
+            y = activations.resolve(name)(x)
+            assert np.all(np.isfinite(np.asarray(y))), name
+
+    def test_unknown_raises(self):
+        with pytest.raises(ValueError):
+            activations.resolve("nope")
+
+    def test_custom_registration(self):
+        activations.register_activation("myact", lambda x: x * 2)
+        np.testing.assert_allclose(
+            activations.resolve("myact")(jnp.ones(3)), 2 * np.ones(3))
+
+
+class TestLosses:
+    def test_mse(self):
+        y = jnp.array([[1.0, 2.0]])
+        pre = jnp.array([[1.5, 1.0]])
+        s = losses.resolve("mse").score(y, pre, "identity")
+        np.testing.assert_allclose(s, 0.25 + 1.0, rtol=1e-6)
+
+    def test_mcxent_softmax_fused_matches_manual(self):
+        key = jax.random.PRNGKey(1)
+        pre = jax.random.normal(key, (5, 7))
+        labels = jax.nn.one_hot(jnp.arange(5) % 7, 7)
+        fused = losses.resolve("mcxent").score_array(labels, pre, "softmax")
+        manual = -jnp.sum(labels * jnp.log(jax.nn.softmax(pre, -1)), -1)
+        np.testing.assert_allclose(np.asarray(fused), np.asarray(manual), rtol=1e-5)
+
+    def test_xent_sigmoid_fused_stable(self):
+        pre = jnp.array([[100.0, -100.0]])
+        labels = jnp.array([[1.0, 0.0]])
+        s = losses.resolve("xent").score(labels, pre, "sigmoid")
+        assert np.isfinite(float(s)) and float(s) < 1e-3
+
+    def test_all_losses_finite_and_differentiable(self):
+        key = jax.random.PRNGKey(2)
+        pre = jax.random.normal(key, (4, 6)) * 0.1
+        labels = jax.nn.softmax(jax.random.normal(jax.random.PRNGKey(3), (4, 6)))
+        for name, act in [("mse", "identity"), ("l1", "tanh"),
+                          ("xent", "sigmoid"), ("mcxent", "softmax"),
+                          ("hinge", "identity"), ("squared_hinge", "identity"),
+                          ("kl_divergence", "softmax"), ("poisson", "softplus"),
+                          ("cosine_proximity", "identity"),
+                          ("mean_absolute_percentage_error", "identity"),
+                          ("mean_squared_logarithmic_error", "sigmoid")]:
+            loss = losses.resolve(name)
+            g = jax.grad(lambda p: loss.score(labels, p, act))(pre)
+            assert np.all(np.isfinite(np.asarray(g))), name
+
+    def test_masked_score(self):
+        labels = jnp.ones((2, 3, 4)) / 4.0
+        pre = jnp.zeros((2, 3, 4))
+        mask = jnp.array([[1.0, 1.0, 0.0], [1.0, 0.0, 0.0]])
+        s = losses.resolve("mse").score_array(labels, pre, "identity", mask)
+        # masked timesteps contribute zero
+        expected_per_t = 4 * (0.25 ** 2)
+        np.testing.assert_allclose(np.asarray(s), [2 * expected_per_t,
+                                                   1 * expected_per_t], rtol=1e-6)
+
+
+class TestWeightInit:
+    def test_shapes_and_stats(self):
+        key = jax.random.PRNGKey(0)
+        w = init_weights(key, (1000, 100), 1000, 100, WeightInit.XAVIER)
+        assert w.shape == (1000, 100)
+        std = float(jnp.std(w))
+        assert abs(std - np.sqrt(2.0 / 1100)) < 0.005
+
+    def test_zero_ones(self):
+        key = jax.random.PRNGKey(0)
+        assert float(jnp.sum(init_weights(key, (3, 3), 3, 3, WeightInit.ZERO))) == 0
+        assert float(jnp.sum(init_weights(key, (3, 3), 3, 3, WeightInit.ONES))) == 9
+
+    def test_distribution(self):
+        key = jax.random.PRNGKey(0)
+        d = Distribution(kind="uniform", lower=2.0, upper=3.0)
+        w = init_weights(key, (100,), 100, 1, WeightInit.DISTRIBUTION, d)
+        assert float(jnp.min(w)) >= 2.0 and float(jnp.max(w)) <= 3.0
+
+    def test_relu_scheme(self):
+        key = jax.random.PRNGKey(0)
+        w = init_weights(key, (2000, 50), 2000, 50, WeightInit.RELU)
+        assert abs(float(jnp.std(w)) - np.sqrt(2.0 / 2000)) < 0.005
+
+
+class TestUpdaters:
+    def _run(self, upd, steps=5):
+        p = jnp.array([1.0, -2.0])
+        g = jnp.array([0.5, -0.5])
+        state = upd.init(p)
+        for i in range(steps):
+            u, state = upd.update(g, state, jnp.asarray(i))
+            p = p - u
+        return p
+
+    def test_sgd(self):
+        p = self._run(updaters.Sgd(learning_rate=0.1), steps=1)
+        np.testing.assert_allclose(p, [0.95, -1.95], rtol=1e-6)
+
+    def test_all_updaters_descend(self):
+        # On a quadratic f(p)=0.5||p||^2, grad=p: every updater must reduce |p|.
+        # AdaDelta's unit-correcting accumulators make it deliberately slow to
+        # start, so it gets a looser bound.
+        for upd, bound in [(updaters.Sgd(0.1), 1.0), (updaters.Adam(0.1), 1.0),
+                           (updaters.AdaMax(0.1), 1.0),
+                           (updaters.AdaGrad(0.1), 1.0),
+                           (updaters.RmsProp(0.1), 1.0),
+                           (updaters.Nesterovs(0.05, momentum=0.5), 1.0),
+                           (updaters.AdaDelta(), 1.2)]:
+            p = jnp.array([1.0, -1.0])
+            state = upd.init(p)
+            for i in range(50):
+                u, state = upd.update(p, state, jnp.asarray(i))
+                p = p - u
+            assert float(jnp.linalg.norm(p)) < bound, type(upd).__name__
+
+    def test_adam_bias_correction_first_step(self):
+        upd = updaters.Adam(learning_rate=0.001)
+        g = jnp.array([0.3])
+        state = upd.init(g)
+        u, _ = upd.update(g, state, jnp.asarray(0))
+        # First Adam step ≈ lr * sign(g)
+        np.testing.assert_allclose(np.asarray(u), [0.001], rtol=1e-3)
+
+    def test_schedules(self):
+        it = jnp.asarray(10)
+        assert float(updaters.ExponentialSchedule(0.9).rate(1.0, it)) == \
+            pytest.approx(0.9 ** 10)
+        assert float(updaters.StepSchedule(0.5, 5).rate(1.0, it)) == \
+            pytest.approx(0.25)
+        ms = updaters.MapSchedule({0: 0.1, 5: 0.01, 20: 0.001})
+        assert float(ms.rate(1.0, jnp.asarray(7))) == pytest.approx(0.01)
+
+    def test_gradient_clipping(self):
+        g = {"W": jnp.array([3.0, 4.0]), "b": jnp.array([0.5])}
+        out = updaters.normalize_layer_gradients(
+            g, updaters.GradientNormalization.CLIP_L2_PER_LAYER, threshold=1.0)
+        norm = float(jnp.sqrt(sum(jnp.sum(v ** 2)
+                                  for v in jax.tree_util.tree_leaves(out))))
+        assert norm == pytest.approx(1.0, rel=1e-5)
+        out2 = updaters.normalize_layer_gradients(
+            g, updaters.GradientNormalization.CLIP_ELEMENT_WISE_ABSOLUTE_VALUE,
+            threshold=1.0)
+        assert float(jnp.max(jnp.abs(out2["W"]))) <= 1.0
+
+
+class TestSerde:
+    def test_updater_roundtrip(self):
+        u = updaters.Adam(learning_rate=0.01, beta1=0.8,
+                          schedule=updaters.StepSchedule(0.5, 100))
+        s = serde.to_json(u)
+        u2 = serde.from_json(s)
+        assert u2 == u
+
+    def test_enum_roundtrip(self):
+        w = WeightInit.XAVIER_UNIFORM
+        assert serde.from_json(serde.to_json(w)) is w
