@@ -1,0 +1,111 @@
+"""Benchmark driver: prints ONE JSON line with the headline metric.
+
+Workload: LeNet-MNIST MultiLayerNetwork training step (BASELINE.json
+configs[0]; reference zoo/model/LeNet.java + MnistDataSetIterator), measured
+as images/sec on the available accelerator. The reference publishes no
+numbers (BASELINE.md), so vs_baseline is reported against the best
+previously-recorded run of this same bench (BENCH_baseline.json, written on
+first run) — i.e. the scoreboard tracks self-improvement round over round.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def build_lenet(height=28, width=28, channels=1, num_classes=10, seed=42):
+    """LeNet per reference zoo/model/LeNet.java: conv5x5x20 → maxpool2 →
+    conv5x5x50 → maxpool2 → dense500(relu) → softmax output."""
+    from deeplearning4j_tpu import (InputType, NeuralNetConfiguration,
+                                    OutputLayer, DenseLayer, Adam, WeightInit)
+    from deeplearning4j_tpu.nn.layers.convolution import (
+        ConvolutionLayer, SubsamplingLayer, ConvolutionMode, PoolingType)
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed)
+            .activation("identity")
+            .weight_init(WeightInit.XAVIER)
+            .updater(Adam(1e-3))
+            .list()
+            .layer(ConvolutionLayer(kernel_size=(5, 5), stride=(1, 1),
+                                    padding=(0, 0), n_out=20,
+                                    convolution_mode=ConvolutionMode.SAME))
+            .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2),
+                                    pooling_type=PoolingType.MAX,
+                                    convolution_mode=ConvolutionMode.SAME))
+            .layer(ConvolutionLayer(kernel_size=(5, 5), stride=(1, 1),
+                                    padding=(0, 0), n_out=50,
+                                    convolution_mode=ConvolutionMode.SAME))
+            .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2),
+                                    pooling_type=PoolingType.MAX,
+                                    convolution_mode=ConvolutionMode.SAME))
+            .layer(DenseLayer(n_out=500, activation="relu"))
+            .layer(OutputLayer(n_out=num_classes, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.convolutional(height, width, channels))
+            .build())
+    return conf
+
+
+def bench_lenet(batch=2048, steps=50, warmup=10, repeats=3):
+    import jax
+    from deeplearning4j_tpu import MultiLayerNetwork
+    from deeplearning4j_tpu.data.dataset import DataSet
+
+    net = MultiLayerNetwork(build_lenet()).init()
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((batch, 28, 28, 1), dtype=np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, size=batch)]
+    # Device-resident batch: the metric is the compiled train-step rate
+    # (host→device streaming is AsyncDataSetIterator's job, benched apart).
+    ds = DataSet(jax.device_put(x), jax.device_put(y))
+
+    # NB: on tunneled platforms block_until_ready does not truly wait;
+    # fetching a scalar (the loss) is the only reliable fence.
+    for _ in range(warmup):
+        net._fit_batch(ds)
+    float(net.score_value)
+
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            net._fit_batch(ds)
+        float(net.score_value)
+        times.append(time.perf_counter() - t0)
+    dt = sorted(times)[len(times) // 2]  # median repeat
+    return (batch * steps) / dt, dt / steps
+
+
+def main():
+    images_per_sec, step_time = bench_lenet()
+
+    baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "BENCH_baseline.json")
+    baseline = None
+    if os.path.exists(baseline_path):
+        try:
+            with open(baseline_path) as f:
+                baseline = json.load(f).get("value")
+        except Exception:
+            baseline = None
+    if baseline is None or images_per_sec > baseline:
+        # Baseline = best run so far, so vs_baseline tracks true regressions.
+        with open(baseline_path, "w") as f:
+            json.dump({"metric": "lenet_mnist_images_per_sec",
+                       "value": images_per_sec}, f)
+        baseline = baseline if baseline is not None else images_per_sec
+
+    print(json.dumps({
+        "metric": "lenet_mnist_images_per_sec",
+        "value": round(images_per_sec, 1),
+        "unit": "images/sec",
+        "vs_baseline": round(images_per_sec / baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

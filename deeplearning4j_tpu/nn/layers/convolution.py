@@ -126,11 +126,12 @@ class ConvolutionLayer(Layer):
         else:
             ph, pw = _pair(self.padding)
             pads = ((ph, ph), (pw, pw))
+        # bf16 convs accumulate in f32 on the MXU by default under XLA; no
+        # preferred_element_type (it breaks the transpose rule's dtype match).
         return lax.conv_general_dilated(
             x, w, window_strides=(sh, sw), padding=pads,
             rhs_dilation=(dh, dw),
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
 
     def forward(self, params, state, x, *, train=False, rng=None, mask=None):
         x = dropout(x, self.dropout_rate, train, rng)

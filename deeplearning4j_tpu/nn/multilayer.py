@@ -176,17 +176,20 @@ class MultiLayerNetwork:
 
     # ------------------------------------------------------------------- fit
     def fit(self, data, labels=None, *, epochs: int = 1, batch_size: int = 32,
-            use_async: bool = True) -> "MultiLayerNetwork":
+            use_async: bool = True, async_queue_size: int = 8,
+            step_fn=None) -> "MultiLayerNetwork":
         """Train (reference fit(DataSetIterator):1019). Accepts a
-        DataSetIterator, a DataSet, or (features, labels) arrays."""
+        DataSetIterator, a DataSet, or (features, labels) arrays. `step_fn`
+        lets ParallelWrapper reuse this loop with a sharded step."""
         self._check_init()
         it = as_iterator(data, labels, batch_size)
-        wrapped = AsyncDataSetIterator(it) if (use_async and it.async_supported()) \
-            else it
+        wrapped = AsyncDataSetIterator(it, async_queue_size) \
+            if (use_async and it.async_supported()) else it
+        step = step_fn or self._fit_batch
         try:
             for _ in range(epochs):
                 for ds in wrapped:
-                    self._fit_batch(ds)
+                    step(ds)
                 self.epoch += 1
                 for lst in self.listeners:
                     if hasattr(lst, "on_epoch_end"):
@@ -196,14 +199,15 @@ class MultiLayerNetwork:
                 wrapped.shutdown()
         return self
 
-    def _fit_batch(self, ds: DataSet):
+    def _fit_batch(self, ds: DataSet, do_step=None):
+        do_step = do_step or self._do_step
         if self.conf.backprop_type == BackpropType.TRUNCATED_BPTT and \
                 ds.features.ndim == 3:
-            self._fit_tbptt(ds)
+            self._fit_tbptt(ds, do_step)
             return
-        self._do_step(ds.features, ds.labels, ds.features_mask, ds.labels_mask)
+        do_step(ds.features, ds.labels, ds.features_mask, ds.labels_mask)
 
-    def _fit_tbptt(self, ds: DataSet):
+    def _fit_tbptt(self, ds: DataSet, do_step):
         """Truncated BPTT: slide a window of tbptt_fwd_length over the time
         axis, one optimizer step per window (reference doTruncatedBPTT:1266).
         Recurrent state carry across windows is handled inside recurrent
@@ -215,20 +219,32 @@ class MultiLayerNetwork:
             end = min(start + L, T)
             fm = None if ds.features_mask is None else ds.features_mask[:, start:end]
             lm = None if ds.labels_mask is None else ds.labels_mask[:, start:end]
-            self._do_step(ds.features[:, start:end], ds.labels[:, start:end], fm, lm,
-                          carry_rnn_state=True)
+            do_step(ds.features[:, start:end], ds.labels[:, start:end], fm, lm)
         self.rnn_clear_previous_state()
 
-    def _do_step(self, x, y, fmask, lmask, carry_rnn_state: bool = False):
-        it = jnp.asarray(self.iteration, jnp.int32)
-        out = self._train_step_fn(
-            self.params_tree, self.opt_state, self.state_tree, it, self._rng,
-            jnp.asarray(x, self._dtype if np.asarray(x).dtype.kind == "f" else None),
-            jnp.asarray(y),
+    def _cast_features(self, x):
+        x = jnp.asarray(x)
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            x = x.astype(self._dtype)
+        return x
+
+    def _do_step(self, x, y, fmask, lmask):
+        self._run_and_commit(
+            self._cast_features(x), jnp.asarray(y),
             None if fmask is None else jnp.asarray(fmask),
             None if lmask is None else jnp.asarray(lmask))
-        (self.params_tree, self.opt_state, new_state, _, self._rng, loss) = out
-        self.state_tree = new_state
+
+    def _run_and_commit(self, x, y, fmask, lmask, mesh=None):
+        """Invoke the jitted step and commit results + listeners. Shared by
+        the single-device path and ParallelWrapper's sharded path."""
+        import contextlib
+        with (mesh if mesh is not None else contextlib.nullcontext()):
+            out = self._train_step_fn(
+                self.params_tree, self.opt_state, self.state_tree,
+                jnp.asarray(self.iteration, jnp.int32), self._rng,
+                x, y, fmask, lmask)
+        (self.params_tree, self.opt_state, self.state_tree, _, self._rng,
+         loss) = out
         self.iteration += 1
         self.score_value = loss
         for lst in self.listeners:

@@ -1,0 +1,10 @@
+"""Parallelism: device meshes, data-parallel training, batched inference.
+
+Replaces the reference's entire deeplearning4j-scaleout tree (ParallelWrapper
+thread zoo, Spark parameter averaging, Aeron parameter server — SURVEY.md
+§2.4) with sharded jit over a jax.sharding.Mesh.
+"""
+from .mesh import (DATA_AXIS, MODEL_AXIS, SEQ_AXIS, batch_sharded,
+                   create_mesh, data_parallel_mesh, replicate, replicated,
+                   shard_batch)
+from .wrapper import ParallelWrapper

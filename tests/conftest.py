@@ -5,16 +5,26 @@ run local[N] in-JVM, BaseSparkTest.java:89): multi-chip sharding is exercised
 on N virtual CPU devices via --xla_force_host_platform_device_count, so the
 full tp/dp test matrix runs on any host. Real-TPU benchmarking happens via
 bench.py, not the test suite.
+
+Gotcha (learned the hard way): a sitecustomize hook may import jax and
+register an accelerator plugin BEFORE this file runs, making JAX_PLATFORMS
+env vars a no-op. jax.config.update after import still works because backend
+initialization is lazy — and we hard-assert the device count so a silent
+single-device fallback can never fake a passing distributed suite again.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
-import jax  # noqa: E402
+import jax  # noqa: E402  (may already be imported by sitecustomize)
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.device_count() == 8, (
+    f"Test suite requires the 8-device virtual CPU mesh, got "
+    f"{jax.devices()} — platform forcing failed")
 
 import pytest  # noqa: E402
 

@@ -160,7 +160,7 @@ class TestUpdaters:
     def test_schedules(self):
         it = jnp.asarray(10)
         assert float(updaters.ExponentialSchedule(0.9).rate(1.0, it)) == \
-            pytest.approx(0.9 ** 10)
+            pytest.approx(0.9 ** 10, rel=1e-5)
         assert float(updaters.StepSchedule(0.5, 5).rate(1.0, it)) == \
             pytest.approx(0.25)
         ms = updaters.MapSchedule({0: 0.1, 5: 0.01, 20: 0.001})
