@@ -13,6 +13,14 @@ from .nn.conf.builders import (BackpropType, MultiLayerConfiguration,
 from .nn.conf.inputs import InputType
 from .nn.layers.core import (ActivationLayer, DenseLayer, DropoutLayer,
                              EmbeddingLayer, LossLayer, OutputLayer)
+from .nn.layers.convolution import (BatchNormalization, Convolution1DLayer,
+                                    ConvolutionLayer, ConvolutionMode,
+                                    GlobalPoolingLayer,
+                                    LocalResponseNormalization, PoolingType,
+                                    Subsampling1DLayer, SubsamplingLayer,
+                                    ZeroPaddingLayer)
+from .nn.layers.recurrent import (LSTM, GravesBidirectionalLSTM, GravesLSTM,
+                                  RnnOutputLayer)
 from .nn.multilayer import MultiLayerNetwork
 from .nn.updaters import (Adam, AdaDelta, AdaGrad, AdaMax, GradientNormalization,
                           Nesterovs, NoOp, RmsProp, Sgd)

@@ -127,6 +127,11 @@ class Layer:
     def is_recurrent(self) -> bool:
         return False
 
+    def supports_streaming(self) -> bool:
+        """False for layers that need the full sequence (reference:
+        GravesBidirectionalLSTM.rnnTimeStep throws)."""
+        return True
+
     def _winit(self, key, shape, fan_in, fan_out, dtype):
         return init_weights(key, shape, fan_in, fan_out,
                             self.weight_init or WeightInit.XAVIER,

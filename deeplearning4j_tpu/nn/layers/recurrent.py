@@ -1,0 +1,271 @@
+"""Recurrent layers: LSTM, GravesLSTM (peepholes), GravesBidirectionalLSTM,
+plus RnnOutputLayer.
+
+Reference parity: nn/layers/recurrent/LSTMHelpers.java (activateHelper :62,
+backpropGradientHelper :291 — all DL4J LSTM math lives there),
+nn/conf/layers/{LSTM,GravesLSTM,GravesBidirectionalLSTM,RnnOutputLayer},
+nn/params/{LSTM,GravesLSTM,GravesBidirectionalLSTM}ParamInitializer.
+Semantics reproduced exactly:
+  * gate order [i, f, o, g] in the packed weight matrices; the "i" block is
+    the candidate and uses the LAYER activation fn (default tanh); f/o/g use
+    the gate activation (sigmoid); cell-output activation = layer activation.
+  * c_t = f ⊙ c_{t-1} + g ⊙ i;  h_t = o ⊙ act(c_t)
+  * Graves peepholes (Greff et al.'s "vanilla" variant): f and g peep at
+    c_{t-1}, o peeps at the CURRENT c_t (LSTMHelpers.java:239-242).
+  * forget-gate bias initialized to forget_gate_bias_init
+    (LSTMParamInitializer.java:107), rest zero.
+  * per-timestep masking zeroes h AND c at masked steps
+    (LSTMHelpers.java:259-267).
+  * bidirectional output = forward-pass output + backward-pass output, an
+    elementwise SUM (GravesBidirectionalLSTM.java:205).
+
+TPU-native redesign: the per-timestep Java loop with in-place gemms becomes
+one lax.scan whose body is a single fused [B, n_in+H] @ [n_in+H, 4H] step —
+XLA keeps the weights resident and pipelines the scan on the MXU. There are
+no hand-written backward passes (reference :291's 200 lines): jax.grad
+differentiates through the scan. Data layout is [batch, time, features]
+(reference uses [batch, features, time]); weights are kept UNFUSED per gate
+block in a packed [*, 4H] matrix identical in ordering to the reference so
+flat-param checkpoints can cross-load.
+
+Statefulness (rnnTimeStep / tBPTT carry): a recurrent layer's state dict is
+EMPTY in standard training (fresh zeros every batch, like the reference's
+normal fit path). MultiLayerNetwork seeds {"h","c"} via seed_recurrent_state
+for streaming/tbptt, and forward then starts from and returns the carry —
+the reference's stateMap (BaseRecurrentLayer.stateMap) made explicit.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...ops import activations as act_ops
+from ...utils import serde
+from ..conf.inputs import InputType, RecurrentType
+from ..weights import WeightInit
+from .core import BIAS, WEIGHT, BaseOutputLayer, Layer, dropout
+
+Array = jax.Array
+
+RECURRENT_WEIGHT = "RW"
+# Peephole weights (GravesLSTM); reference packs them as RW columns 4H..4H+3.
+PEEP_F = "wF"
+PEEP_O = "wO"
+PEEP_G = "wG"
+
+
+def _scan_rnn(cell, x, h0, c0, mask, reverse=False):
+    """Run `cell(xt, h, c) -> (h', c')` over the time axis of [B, T, F] data.
+
+    Outputs are aligned to input time positions for both directions (lax.scan
+    reverse=True). Mask [B, T] zeroes h and c at masked steps."""
+    xT = jnp.swapaxes(x, 0, 1)  # [T, B, F]
+    if mask is not None:
+        mT = jnp.swapaxes(mask.astype(h0.dtype), 0, 1)[..., None]  # [T, B, 1]
+
+        def step(carry, inp):
+            h, c = carry
+            xt, mt = inp
+            h2, c2 = cell(xt, h, c)
+            h2 = h2 * mt
+            c2 = c2 * mt
+            return (h2, c2), h2
+
+        (hT, cT), ys = lax.scan(step, (h0, c0), (xT, mT), reverse=reverse)
+    else:
+
+        def step(carry, xt):
+            h, c = carry
+            h2, c2 = cell(xt, h, c)
+            return (h2, c2), h2
+
+        (hT, cT), ys = lax.scan(step, (h0, c0), xT, reverse=reverse)
+    return jnp.swapaxes(ys, 0, 1), hT, cT
+
+
+@serde.register
+@dataclass
+class LSTM(Layer):
+    """LSTM without peepholes (reference nn/conf/layers/LSTM; the
+    "no peephole" variant of Greff et al.)."""
+
+    n_in: int = 0
+    n_out: int = 0
+    forget_gate_bias_init: float = 1.0
+    gate_activation: str = "sigmoid"
+
+    def input_kind(self):
+        return "rnn"
+
+    def is_recurrent(self):
+        return True
+
+    def has_params(self):
+        return True
+
+    def set_input_type(self, input_type: InputType):
+        if not isinstance(input_type, RecurrentType):
+            raise ValueError(f"{type(self).__name__} needs RNN input, got "
+                             f"{input_type}")
+        if self.n_in == 0:
+            self.n_in = input_type.size
+        return RecurrentType(size=self.n_out,
+                             timeseries_length=input_type.timeseries_length)
+
+    # -- params ------------------------------------------------------------
+    def _has_peepholes(self) -> bool:
+        return False
+
+    def init_params(self, key, dtype=jnp.float32):
+        H, nI = self.n_out, self.n_in
+        # Reference fan values: fanIn = nL, fanOut = nLast + nL
+        # (LSTMParamInitializer.java:98-99), same for W and RW.
+        fan_in, fan_out = H, nI + H
+        kW, kR, kP = jax.random.split(key, 3)
+        w = self._winit(kW, (nI, 4 * H), fan_in, fan_out, dtype)
+        rw = self._winit(kR, (H, 4 * H), fan_in, fan_out, dtype)
+        b = jnp.zeros((4 * H,), dtype)
+        b = b.at[H:2 * H].set(self.forget_gate_bias_init)
+        params = {WEIGHT: w, RECURRENT_WEIGHT: rw, BIAS: b}
+        if self._has_peepholes():
+            kF, kO, kG = jax.random.split(kP, 3)
+            for name, k in ((PEEP_F, kF), (PEEP_O, kO), (PEEP_G, kG)):
+                params[name] = self._winit(k, (H,), fan_in, fan_out, dtype)
+        return params
+
+    def param_reg(self, pname):
+        if pname in (WEIGHT, RECURRENT_WEIGHT):
+            return (self.l1 or 0.0, self.l2 or 0.0)
+        if pname == BIAS:
+            return (self.l1_bias or 0.0, self.l2_bias or 0.0)
+        return (0.0, 0.0)
+
+    # -- math --------------------------------------------------------------
+    def _cell(self, params, prefix=""):
+        H = self.n_out
+        act = self._act()
+        gate = act_ops.resolve(self.gate_activation)
+        W = params[prefix + WEIGHT]
+        RW = params[prefix + RECURRENT_WEIGHT]
+        b = params[prefix + BIAS]
+        peep = self._has_peepholes()
+        if peep:
+            wF, wO, wG = (params[prefix + PEEP_F], params[prefix + PEEP_O],
+                          params[prefix + PEEP_G])
+
+        def cell(xt, h, c):
+            z = xt @ W + h @ RW + b  # [B, 4H], gate order [i, f, o, g]
+            zi, zf, zo, zg = (z[:, :H], z[:, H:2 * H], z[:, 2 * H:3 * H],
+                              z[:, 3 * H:])
+            i = act(zi)  # candidate: LAYER activation (LSTMHelpers:194)
+            if peep:
+                zf = zf + c * wF
+                zg = zg + c * wG
+            f = gate(zf)
+            g = gate(zg)
+            c2 = f * c + g * i
+            if peep:
+                zo = zo + c2 * wO  # output gate peeps at CURRENT cell state
+            o = gate(zo)
+            h2 = o * act(c2)
+            return h2, c2
+
+        return cell
+
+    def _zeros_state(self, batch, dtype):
+        H = self.n_out
+        return (jnp.zeros((batch, H), dtype), jnp.zeros((batch, H), dtype))
+
+    def supports_streaming(self) -> bool:
+        return True
+
+    def seed_recurrent_state(self, batch: int, dtype) -> dict:
+        h, c = self._zeros_state(batch, dtype)
+        return {"h": h, "c": c}
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = dropout(x, self.dropout_rate, train, rng)
+        single_step = x.ndim == 2  # rnnTimeStep: [B, F] one step
+        if single_step:
+            x = x[:, None, :]
+        carry_dt = jnp.result_type(x.dtype, params[WEIGHT].dtype)
+        stateful = bool(state) and "h" in state
+        if stateful:
+            h0, c0 = state["h"].astype(carry_dt), state["c"].astype(carry_dt)
+        else:
+            h0, c0 = self._zeros_state(x.shape[0], carry_dt)
+        ys, hT, cT = _scan_rnn(self._cell(params), x, h0, c0, mask)
+        new_state = {"h": hT, "c": cT} if stateful else state
+        if single_step:
+            ys = ys[:, 0, :]
+        return ys, new_state
+
+
+@serde.register
+@dataclass
+class GravesLSTM(LSTM):
+    """LSTM with peephole connections (reference nn/conf/layers/GravesLSTM,
+    Graves' "Supervised Sequence Labelling" variant)."""
+
+    def _has_peepholes(self) -> bool:
+        return True
+
+
+@serde.register
+@dataclass
+class GravesBidirectionalLSTM(GravesLSTM):
+    """Bidirectional Graves LSTM; output is the elementwise SUM of the
+    forward and backward passes (reference GravesBidirectionalLSTM.java:205).
+    No streaming state (rnnTimeStep needs the full sequence, as in the
+    reference)."""
+
+    def init_params(self, key, dtype=jnp.float32):
+        kf, kb = jax.random.split(key)
+        fwd = GravesLSTM.init_params(self, kf, dtype)
+        bwd = GravesLSTM.init_params(self, kb, dtype)
+        out = {"F" + k: v for k, v in fwd.items()}
+        out.update({"B" + k: v for k, v in bwd.items()})
+        return out
+
+    def param_reg(self, pname):
+        return LSTM.param_reg(self, pname[1:])
+
+    def supports_streaming(self) -> bool:
+        return False  # reference throws UnsupportedOperationException
+
+    def seed_recurrent_state(self, batch, dtype) -> dict:
+        return {}
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = dropout(x, self.dropout_rate, train, rng)
+        carry_dt = jnp.result_type(x.dtype, params["F" + WEIGHT].dtype)
+        h0, c0 = self._zeros_state(x.shape[0], carry_dt)
+        fwd, _, _ = _scan_rnn(self._cell(params, "F"), x, h0, c0, mask)
+        bwd, _, _ = _scan_rnn(self._cell(params, "B"), x, h0, c0, mask,
+                              reverse=True)
+        return fwd + bwd, state
+
+
+@serde.register
+@dataclass
+class RnnOutputLayer(BaseOutputLayer):
+    """Time-distributed dense + loss head over [batch, time, features]
+    (reference nn/conf/layers/RnnOutputLayer / nn/layers/recurrent/
+    RnnOutputLayer — reshapes to 2d and back; here broadcasting matmul does
+    the time distribution and the labels mask [batch, time] zeroes padded
+    steps in the score)."""
+
+    def input_kind(self):
+        return "rnn"
+
+    def set_input_type(self, input_type):
+        if isinstance(input_type, RecurrentType):
+            if self.n_in == 0:
+                self.n_in = input_type.size
+            return RecurrentType(size=self.n_out,
+                                 timeseries_length=input_type.timeseries_length)
+        raise ValueError(f"RnnOutputLayer needs RNN input, got {input_type}")

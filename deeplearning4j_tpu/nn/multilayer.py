@@ -62,6 +62,9 @@ class MultiLayerNetwork:
             raise ValueError("Configuration has no layers")
         self.params_tree: Optional[Tuple[dict, ...]] = None
         self.state_tree: Optional[Tuple[dict, ...]] = None
+        # Streaming/tbptt recurrent carry (reference stateMap). Kept OUT of
+        # state_tree so output()/score()/standard fit() are always stateless.
+        self._rnn_carry: Optional[Tuple[dict, ...]] = None
         self.opt_state: Optional[Tuple[Any, ...]] = None
         self.iteration = 0
         self.epoch = 0
@@ -170,6 +173,9 @@ class MultiLayerNetwork:
         self._output_fn = jax.jit(
             lambda params, state, x, fmask:
             self._forward_pure(params, state, x, False, None, fmask)[0])
+        self._rnn_step_fn = jax.jit(
+            lambda params, state, x:
+            self._forward_pure(params, state, x, False, None, None)[:2])
         self._loss_fn_jit = jax.jit(
             lambda params, state, x, y, fmask, lmask:
             self._loss_pure(params, state, x, y, fmask, lmask, None, False)[0])
@@ -205,16 +211,18 @@ class MultiLayerNetwork:
                 ds.features.ndim == 3:
             self._fit_tbptt(ds, do_step)
             return
+        self._rnn_carry = None  # standard BPTT: every batch starts fresh
         do_step(ds.features, ds.labels, ds.features_mask, ds.labels_mask)
 
     def _fit_tbptt(self, ds: DataSet, do_step):
         """Truncated BPTT: slide a window of tbptt_fwd_length over the time
         axis, one optimizer step per window (reference doTruncatedBPTT:1266).
-        Recurrent state carry across windows is handled inside recurrent
-        layers via the state tree."""
+        Recurrent state carry across windows rides the state tree, seeded
+        here (the reference's rnnActivateUsingStoredState)."""
         T = ds.features.shape[1]
         L = self.conf.tbptt_fwd_length
         self.rnn_clear_previous_state()
+        self._seed_recurrent_states(ds.features.shape[0])
         for start in range(0, T, L):
             end = min(start + L, T)
             fm = None if ds.features_mask is None else ds.features_mask[:, start:end]
@@ -240,15 +248,36 @@ class MultiLayerNetwork:
         import contextlib
         with (mesh if mesh is not None else contextlib.nullcontext()):
             out = self._train_step_fn(
-                self.params_tree, self.opt_state, self.state_tree,
+                self.params_tree, self.opt_state, self._merged_state(),
                 jnp.asarray(self.iteration, jnp.int32), self._rng,
                 x, y, fmask, lmask)
-        (self.params_tree, self.opt_state, self.state_tree, _, self._rng,
+        (self.params_tree, self.opt_state, new_state, _, self._rng,
          loss) = out
+        self._commit_state(new_state)
         self.iteration += 1
         self.score_value = loss
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration)
+
+    # The recurrent carry is merged into the state only on stateful paths
+    # (tbptt windows, rnn_time_step) and split back out on commit, so the
+    # canonical state_tree never contains h/c.
+    def _merged_state(self):
+        if self._rnn_carry is None:
+            return self.state_tree
+        return tuple({**st, **carry} for st, carry in
+                     zip(self.state_tree, self._rnn_carry))
+
+    def _commit_state(self, new_state):
+        if self._rnn_carry is None:
+            self.state_tree = new_state
+            return
+        base, carry = [], []
+        for st in new_state:
+            carry.append({k: v for k, v in st.items() if k in ("h", "c")})
+            base.append({k: v for k, v in st.items() if k not in ("h", "c")})
+        self.state_tree = tuple(base)
+        self._rnn_carry = tuple(carry)
 
     # ------------------------------------------------------------- inference
     def output(self, x, train: bool = False, features_mask=None) -> np.ndarray:
@@ -338,26 +367,35 @@ class MultiLayerNetwork:
         return param_utils.num_params(self.params_tree)
 
     # ------------------------------------------------------------- rnn state
+    def _seed_recurrent_states(self, batch: int):
+        """Activate the recurrent carry with zeroed state (the reference's
+        stateMap initialization)."""
+        if self._rnn_carry is None:
+            self._rnn_carry = tuple(
+                layer.seed_recurrent_state(batch, self._dtype)
+                if layer.is_recurrent() else {}
+                for layer in self.layers)
+
     def rnn_clear_previous_state(self):
-        """Reset recurrent stateful buffers (reference
-        rnnClearPreviousState())."""
-        if self.state_tree is None:
-            return
-        new_states = []
-        for layer, st in zip(self.layers, self.state_tree):
-            if layer.is_recurrent() and st:
-                new_states.append(jax.tree_util.tree_map(jnp.zeros_like, st))
-            else:
-                new_states.append(st)
-        self.state_tree = tuple(new_states)
+        """Drop recurrent carries (reference rnnClearPreviousState())."""
+        self._rnn_carry = None
 
     def rnn_time_step(self, x) -> np.ndarray:
-        """Single-step streaming inference with carried recurrent state
-        (reference rnnTimeStep())."""
+        """Streaming inference with carried recurrent state (reference
+        rnnTimeStep()). Accepts [batch, features] (one step) or
+        [batch, time, features]. Raises for layers that cannot stream
+        (GravesBidirectionalLSTM, like the reference)."""
         self._check_init()
-        out, new_state, _ = self._forward_pure(
-            self.params_tree, self.state_tree, jnp.asarray(x), False, None, None)
-        self.state_tree = new_state
+        for layer in self.layers:
+            if layer.is_recurrent() and not layer.supports_streaming():
+                raise NotImplementedError(
+                    f"{type(layer).__name__} does not support rnn_time_step "
+                    "(needs the full sequence)")
+        x = self._cast_features(x)
+        self._seed_recurrent_states(x.shape[0])
+        out, new_state = self._rnn_step_fn(
+            self.params_tree, self._merged_state(), x)
+        self._commit_state(new_state)
         return np.asarray(out)
 
     # --------------------------------------------------------------- helpers

@@ -1,0 +1,191 @@
+"""LSTM family tests: gradient checks (the reference's
+LSTMGradientCheckTests model), masking, tBPTT, rnnTimeStep streaming
+equivalence, and end-to-end sequence learning."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (LSTM, Adam, GravesBidirectionalLSTM,
+                                GravesLSTM, InputType, MultiLayerNetwork,
+                                NeuralNetConfiguration, RnnOutputLayer, Sgd)
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.nn.conf.builders import BackpropType
+from deeplearning4j_tpu.utils.gradient_check import gradient_check_mln
+
+
+def _rnn_conf(layer_cls=GravesLSTM, n_in=4, hidden=6, n_out=3, seed=3,
+              updater=None, **kw):
+    return (NeuralNetConfiguration.builder()
+            .seed(seed)
+            .updater(updater or Sgd(0.1))
+            .list()
+            .layer(layer_cls(n_out=hidden, activation="tanh"))
+            .layer(RnnOutputLayer(n_out=n_out, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(InputType.recurrent(n_in))
+            .build(), kw)
+
+
+def _seq_data(b=5, t=7, n_in=4, n_out=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((b, t, n_in)).astype(np.float32)
+    y = np.eye(n_out, dtype=np.float32)[rng.integers(0, n_out, (b, t))]
+    return x, y
+
+
+class TestLSTMForward:
+    @pytest.mark.parametrize("cls", [LSTM, GravesLSTM, GravesBidirectionalLSTM])
+    def test_shapes(self, cls):
+        conf, _ = _rnn_conf(cls)
+        net = MultiLayerNetwork(conf).init()
+        x, y = _seq_data()
+        out = net.output(x)
+        assert out.shape == (5, 7, 3)
+        np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-5)
+
+    def test_forget_bias_init(self):
+        layer = GravesLSTM(n_in=4, n_out=6, forget_gate_bias_init=1.0)
+        layer.weight_init = None
+        from deeplearning4j_tpu.nn.weights import WeightInit
+        layer.weight_init = WeightInit.XAVIER
+        layer.bias_init = 0.0
+        p = layer.init_params(jax.random.PRNGKey(0))
+        b = np.asarray(p["b"])
+        np.testing.assert_allclose(b[6:12], 1.0)
+        np.testing.assert_allclose(b[:6], 0.0)
+        np.testing.assert_allclose(b[12:], 0.0)
+        assert set(p) == {"W", "RW", "b", "wF", "wO", "wG"}
+        assert p["W"].shape == (4, 24) and p["RW"].shape == (6, 24)
+
+    def test_masking_zeroes_states(self):
+        """Masked trailing steps must not affect earlier outputs, and masked
+        positions carry zero hidden state (reference LSTMHelpers:259)."""
+        conf, _ = _rnn_conf(GravesLSTM)
+        net = MultiLayerNetwork(conf).init()
+        x, _ = _seq_data(b=2, t=6)
+        mask = np.ones((2, 6), np.float32)
+        mask[1, 4:] = 0.0
+        full = net.output(x, features_mask=mask)
+        # Same sequence truncated at t=4 for example 1: outputs up to t=4 equal
+        trunc = net.output(x[:, :4], features_mask=mask[:, :4])
+        np.testing.assert_allclose(full[1, :4], trunc[1], rtol=1e-5, atol=1e-6)
+
+
+class TestLSTMGradients:
+    @pytest.mark.parametrize("cls", [LSTM, GravesLSTM, GravesBidirectionalLSTM])
+    def test_gradient_check(self, cls):
+        jax.config.update("jax_enable_x64", True)
+        try:
+            conf, _ = _rnn_conf(cls, n_in=3, hidden=4, n_out=2)
+            net = MultiLayerNetwork(conf).init(dtype=jnp.float64)
+            x, y = _seq_data(b=3, t=4, n_in=3, n_out=2)
+            assert gradient_check_mln(net, x, y, max_params=60)
+        finally:
+            jax.config.update("jax_enable_x64", False)
+
+    def test_gradient_check_masked(self):
+        jax.config.update("jax_enable_x64", True)
+        try:
+            conf, _ = _rnn_conf(GravesLSTM, n_in=3, hidden=4, n_out=2)
+            net = MultiLayerNetwork(conf).init(dtype=jnp.float64)
+            x, y = _seq_data(b=3, t=5, n_in=3, n_out=2)
+            mask = np.ones((3, 5), np.float32)
+            mask[0, 3:] = 0.0
+            mask[2, 1:] = 0.0
+            assert gradient_check_mln(net, x, y, features_mask=mask,
+                                      labels_mask=mask, max_params=60)
+        finally:
+            jax.config.update("jax_enable_x64", False)
+
+
+class TestStreaming:
+    def test_rnn_time_step_matches_full_forward(self):
+        """Streaming one step at a time == one full-sequence forward
+        (reference rnnTimeStep contract)."""
+        conf, _ = _rnn_conf(GravesLSTM)
+        net = MultiLayerNetwork(conf).init()
+        x, _ = _seq_data(b=2, t=6)
+        full = net.output(x)
+        net.rnn_clear_previous_state()
+        outs = [net.rnn_time_step(x[:, t]) for t in range(6)]
+        streamed = np.stack(outs, axis=1)
+        np.testing.assert_allclose(streamed, full, rtol=1e-4, atol=1e-5)
+
+    def test_clear_state_resets(self):
+        conf, _ = _rnn_conf(GravesLSTM)
+        net = MultiLayerNetwork(conf).init()
+        x, _ = _seq_data(b=2, t=3)
+        a = net.rnn_time_step(x[:, 0])
+        net.rnn_time_step(x[:, 1])
+        net.rnn_clear_previous_state()
+        b = net.rnn_time_step(x[:, 0])
+        np.testing.assert_allclose(a, b, rtol=1e-5)
+
+    def test_chunked_streaming(self):
+        """rnnTimeStep with multi-step chunks carries state across calls."""
+        conf, _ = _rnn_conf(GravesLSTM)
+        net = MultiLayerNetwork(conf).init()
+        x, _ = _seq_data(b=2, t=8)
+        full = net.output(x)
+        net.rnn_clear_previous_state()
+        part1 = net.rnn_time_step(x[:, :5])
+        part2 = net.rnn_time_step(x[:, 5:])
+        np.testing.assert_allclose(np.concatenate([part1, part2], 1), full,
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestStateIsolation:
+    def test_output_unaffected_by_streaming_state(self):
+        """output()/fit() must be stateless even after rnn_time_step seeded a
+        carry (reference: stateMap only read by rnnTimeStep/tbptt)."""
+        conf, _ = _rnn_conf(GravesLSTM)
+        net = MultiLayerNetwork(conf).init()
+        x, y = _seq_data(b=2, t=5)
+        before = net.output(x)
+        net.rnn_time_step(x[:, 0])
+        net.rnn_time_step(x[:, 1])
+        after = net.output(x)
+        np.testing.assert_allclose(before, after, rtol=1e-6)
+        # fit with a DIFFERENT batch size right after streaming must work
+        x2, y2 = _seq_data(b=7, t=5)
+        net.fit(DataSet(x2, y2), epochs=1, batch_size=7)
+
+    def test_bidirectional_streaming_raises(self):
+        conf, _ = _rnn_conf(GravesBidirectionalLSTM)
+        net = MultiLayerNetwork(conf).init()
+        x, _ = _seq_data(b=2, t=5)
+        with pytest.raises(NotImplementedError):
+            net.rnn_time_step(x[:, 0])
+
+
+class TestTbptt:
+    def test_tbptt_runs_and_learns(self):
+        conf, _ = _rnn_conf(GravesLSTM, updater=Adam(0.02))
+        conf.backprop_type = BackpropType.TRUNCATED_BPTT
+        conf.tbptt_fwd_length = 4
+        net = MultiLayerNetwork(conf).init()
+        # Learnable toy task: predict class of current input quadrant
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((8, 12, 4)).astype(np.float32)
+        cls = (x[..., 0] > 0).astype(int)
+        y = np.eye(3, dtype=np.float32)[cls]
+        ds = DataSet(x, y)
+        net._fit_batch(ds)
+        # 3 windows of length 4 -> 3 optimizer steps per batch
+        assert net.iteration == 3
+        s0 = float(net.score_value)
+        for _ in range(30):
+            net._fit_batch(ds)
+        assert float(net.score_value) < s0
+
+    def test_sequence_learning_standard_bptt(self):
+        conf, _ = _rnn_conf(GravesLSTM, updater=Adam(0.05))
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(2)
+        x = rng.standard_normal((16, 6, 4)).astype(np.float32)
+        cls = (np.cumsum(x[..., 0], axis=1) > 0).astype(int)
+        y = np.eye(3, dtype=np.float32)[cls]
+        net.fit(DataSet(x, y), epochs=60, batch_size=16)
+        acc = (net.predict(x) == cls).mean()
+        assert acc > 0.8
