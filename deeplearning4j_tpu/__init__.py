@@ -22,6 +22,10 @@ from .nn.layers.convolution import (BatchNormalization, Convolution1DLayer,
 from .nn.layers.recurrent import (LSTM, GravesBidirectionalLSTM, GravesLSTM,
                                   RnnOutputLayer)
 from .nn.multilayer import MultiLayerNetwork
+from .nn.graph import (ComputationGraph, ElementWiseVertex, L2NormalizeVertex,
+                       L2Vertex, LastTimeStepVertex, MergeVertex,
+                       PreprocessorVertex, ReshapeVertex, ScaleVertex,
+                       ShiftVertex, StackVertex, SubsetVertex, UnstackVertex)
 from .nn.updaters import (Adam, AdaDelta, AdaGrad, AdaMax, GradientNormalization,
                           Nesterovs, NoOp, RmsProp, Sgd)
 from .nn.weights import Distribution, WeightInit

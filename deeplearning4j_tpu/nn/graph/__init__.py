@@ -1,0 +1,7 @@
+"""ComputationGraph: DAG networks (reference deeplearning4j-nn nn/graph)."""
+from .graph import ComputationGraph
+from .vertices import (DuplicateToTimeSeriesVertex, ElementWiseVertex,
+                       GraphVertex, L2NormalizeVertex, L2Vertex,
+                       LastTimeStepVertex, MergeVertex, PreprocessorVertex,
+                       ReshapeVertex, ScaleVertex, ShiftVertex, StackVertex,
+                       SubsetVertex, UnstackVertex)

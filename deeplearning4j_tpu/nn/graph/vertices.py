@@ -1,0 +1,333 @@
+"""Graph vertices: the DAG building blocks of ComputationGraph.
+
+Reference parity: nn/graph/vertex/impl/{LayerVertex, MergeVertex,
+ElementWiseVertex, SubsetVertex, StackVertex, UnstackVertex, ScaleVertex,
+ShiftVertex, ReshapeVertex, L2NormalizeVertex, L2Vertex, PreprocessorVertex,
+rnn/LastTimeStepVertex, rnn/DuplicateToTimeSeriesVertex} and their config
+mirrors in nn/conf/graph/.
+
+TPU-native: a vertex is a pure function over its input activations —
+`forward(inputs, ...) -> array`; there is no doBackward (autodiff) and no
+per-vertex param views (LayerVertex params live in the graph's params dict).
+Feature axis is LAST everywhere (NHWC / [b,t,f]), so merge/subset axes are
+-1 where the reference uses dimension 1 of NCHW/[b,f,t].
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ...utils import serde
+from ..conf.inputs import (ConvolutionalType, FeedForwardType, InputPreProcessor,
+                           InputType, RecurrentType)
+
+Array = jax.Array
+
+
+def _feature_size(t: InputType) -> int:
+    if isinstance(t, FeedForwardType):
+        return t.size
+    if isinstance(t, RecurrentType):
+        return t.size
+    if isinstance(t, ConvolutionalType):
+        return t.channels
+    raise ValueError(f"No feature size for {t}")
+
+
+def _with_feature_size(t: InputType, n: int) -> InputType:
+    if isinstance(t, FeedForwardType):
+        return FeedForwardType(size=n)
+    if isinstance(t, RecurrentType):
+        return RecurrentType(size=n, timeseries_length=t.timeseries_length)
+    if isinstance(t, ConvolutionalType):
+        return ConvolutionalType(height=t.height, width=t.width, channels=n)
+    raise ValueError(f"Cannot set feature size on {t}")
+
+
+@serde.register
+@dataclass
+class GraphVertex:
+    """Parameterless pure vertex. Subclasses override forward/output_type."""
+
+    def n_inputs(self) -> int | None:
+        return None  # None = any
+
+    def forward(self, inputs: List[Array], *, train: bool = False,
+                rng: Optional[Array] = None,
+                masks: Optional[List[Optional[Array]]] = None) -> Array:
+        raise NotImplementedError
+
+    def output_type(self, input_types: List[InputType]) -> InputType:
+        raise NotImplementedError
+
+    def output_mask(self, masks: List[Optional[Array]]) -> Optional[Array]:
+        """Propagate per-timestep masks through the vertex (reference
+        GraphVertex.feedForwardMaskArrays)."""
+        for m in masks:
+            if m is not None:
+                return m
+        return None
+
+
+@serde.register
+@dataclass
+class MergeVertex(GraphVertex):
+    """Concatenate along the feature axis (reference MergeVertex: dim 1 of
+    NCHW == channels; here NHWC channels / last axis)."""
+
+    def forward(self, inputs, *, train=False, rng=None, masks=None):
+        return jnp.concatenate(inputs, axis=-1)
+
+    def output_type(self, input_types):
+        n = sum(_feature_size(t) for t in input_types)
+        return _with_feature_size(input_types[0], n)
+
+
+@serde.register
+@dataclass
+class ElementWiseVertex(GraphVertex):
+    """Elementwise Add/Subtract/Product/Average/Max (reference
+    ElementWiseVertex.Op)."""
+
+    op: str = "add"  # add | subtract | product | average | max
+
+    def forward(self, inputs, *, train=False, rng=None, masks=None):
+        op = self.op.lower()
+        if op == "subtract":
+            if len(inputs) != 2:
+                raise ValueError("subtract needs exactly 2 inputs")
+            return inputs[0] - inputs[1]
+        out = inputs[0]
+        for x in inputs[1:]:
+            if op == "add":
+                out = out + x
+            elif op == "product":
+                out = out * x
+            elif op == "max":
+                out = jnp.maximum(out, x)
+            elif op == "average":
+                out = out + x
+            else:
+                raise ValueError(f"Unknown ElementWiseVertex op {self.op!r}")
+        if op == "average":
+            out = out / len(inputs)
+        return out
+
+    def output_type(self, input_types):
+        return input_types[0]
+
+
+@serde.register
+@dataclass
+class SubsetVertex(GraphVertex):
+    """Feature-range slice [from, to] inclusive (reference SubsetVertex)."""
+
+    from_idx: int = 0
+    to_idx: int = 0
+
+    def forward(self, inputs, *, train=False, rng=None, masks=None):
+        return inputs[0][..., self.from_idx:self.to_idx + 1]
+
+    def output_type(self, input_types):
+        return _with_feature_size(input_types[0], self.to_idx - self.from_idx + 1)
+
+
+@serde.register
+@dataclass
+class StackVertex(GraphVertex):
+    """Stack minibatches along the batch axis (reference StackVertex, used
+    for transfer-learning style sharing)."""
+
+    def forward(self, inputs, *, train=False, rng=None, masks=None):
+        return jnp.concatenate(inputs, axis=0)
+
+    def output_type(self, input_types):
+        return input_types[0]
+
+    def output_mask(self, masks):
+        if all(m is None for m in masks):
+            return None
+        ms = [m for m in masks if m is not None]
+        if len(ms) != len(masks):
+            raise ValueError("StackVertex: all or none of the inputs must "
+                             "have masks")
+        return jnp.concatenate(ms, axis=0)
+
+
+@serde.register
+@dataclass
+class UnstackVertex(GraphVertex):
+    """Take the i-th of n equal batch slices (reference UnstackVertex)."""
+
+    from_idx: int = 0
+    stack_size: int = 1
+
+    def forward(self, inputs, *, train=False, rng=None, masks=None):
+        x = inputs[0]
+        step = x.shape[0] // self.stack_size
+        return x[self.from_idx * step:(self.from_idx + 1) * step]
+
+    def output_type(self, input_types):
+        return input_types[0]
+
+    def output_mask(self, masks):
+        m = masks[0]
+        if m is None:
+            return None
+        step = m.shape[0] // self.stack_size
+        return m[self.from_idx * step:(self.from_idx + 1) * step]
+
+
+@serde.register
+@dataclass
+class ScaleVertex(GraphVertex):
+    scale_factor: float = 1.0
+
+    def forward(self, inputs, *, train=False, rng=None, masks=None):
+        return inputs[0] * self.scale_factor
+
+    def output_type(self, input_types):
+        return input_types[0]
+
+
+@serde.register
+@dataclass
+class ShiftVertex(GraphVertex):
+    shift_factor: float = 0.0
+
+    def forward(self, inputs, *, train=False, rng=None, masks=None):
+        return inputs[0] + self.shift_factor
+
+    def output_type(self, input_types):
+        return input_types[0]
+
+
+@serde.register
+@dataclass
+class ReshapeVertex(GraphVertex):
+    """Reshape to [batch, *new_shape] (reference ReshapeVertex)."""
+
+    new_shape: Sequence[int] = ()
+
+    def forward(self, inputs, *, train=False, rng=None, masks=None):
+        x = inputs[0]
+        return x.reshape((x.shape[0],) + tuple(self.new_shape))
+
+    def output_type(self, input_types):
+        shape = tuple(self.new_shape)
+        if len(shape) == 1:
+            return FeedForwardType(size=shape[0])
+        if len(shape) == 2:
+            return RecurrentType(size=shape[1], timeseries_length=shape[0])
+        if len(shape) == 3:
+            return ConvolutionalType(height=shape[0], width=shape[1],
+                                     channels=shape[2])
+        raise ValueError(f"Unsupported reshape target {shape}")
+
+
+@serde.register
+@dataclass
+class L2NormalizeVertex(GraphVertex):
+    """x / ||x||_2 over non-batch dims (reference L2NormalizeVertex)."""
+
+    eps: float = 1e-8
+
+    def forward(self, inputs, *, train=False, rng=None, masks=None):
+        x = inputs[0]
+        norm = jnp.sqrt(jnp.sum(
+            (x * x).reshape(x.shape[0], -1), axis=-1))
+        norm = jnp.clip(norm, self.eps, None)
+        return x / norm.reshape((-1,) + (1,) * (x.ndim - 1))
+
+    def output_type(self, input_types):
+        return input_types[0]
+
+
+@serde.register
+@dataclass
+class L2Vertex(GraphVertex):
+    """Pairwise L2 distance between two activations (reference L2Vertex;
+    used by FaceNet-style triplet setups)."""
+
+    eps: float = 1e-8
+
+    def forward(self, inputs, *, train=False, rng=None, masks=None):
+        a, b = inputs
+        d = (a - b).reshape(a.shape[0], -1)
+        return jnp.sqrt(jnp.sum(d * d, axis=-1) + self.eps)[:, None]
+
+    def output_type(self, input_types):
+        return FeedForwardType(size=1)
+
+
+@serde.register
+@dataclass
+class PreprocessorVertex(GraphVertex):
+    """Wrap an InputPreProcessor as a standalone vertex (reference
+    PreprocessorVertex)."""
+
+    preprocessor: Optional[InputPreProcessor] = None
+
+    def forward(self, inputs, *, train=False, rng=None, masks=None):
+        return self.preprocessor(inputs[0])
+
+    def output_type(self, input_types):
+        return self.preprocessor.output_type(input_types[0])
+
+
+@serde.register
+@dataclass
+class LastTimeStepVertex(GraphVertex):
+    """[b, t, f] -> [b, f] at the last UNMASKED step per example (reference
+    rnn/LastTimeStepVertex). `mask_input` names which network input's mask
+    applies (resolved by the graph runtime into `masks`)."""
+
+    mask_input: Optional[str] = None
+
+    def forward(self, inputs, *, train=False, rng=None, masks=None):
+        x = inputs[0]
+        mask = masks[0] if masks else None
+        if mask is None:
+            return x[:, -1, :]
+        # Last NONZERO index per example (handles interior mask gaps, like
+        # the reference's per-example last-step search).
+        T = x.shape[1]
+        idx = T - 1 - jnp.argmax(mask[:, ::-1] > 0, axis=1)  # [b]
+        return jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0, :]
+
+    def output_type(self, input_types):
+        t = input_types[0]
+        if not isinstance(t, RecurrentType):
+            raise ValueError(f"LastTimeStepVertex needs RNN input, got {t}")
+        return FeedForwardType(size=t.size)
+
+    def output_mask(self, masks):
+        return None  # output is no longer a time series
+
+
+@serde.register
+@dataclass
+class DuplicateToTimeSeriesVertex(GraphVertex):
+    """[b, f] -> [b, t, f] by duplication; t comes from a reference input
+    (reference rnn/DuplicateToTimeSeriesVertex)."""
+
+    reference_input: Optional[str] = None
+    # Bound by the graph config when the reference input's type is known:
+    timeseries_length: Optional[int] = None
+
+    def forward(self, inputs, *, train=False, rng=None, masks=None):
+        x, ref = inputs[0], inputs[1]
+        t = ref.shape[1]
+        return jnp.broadcast_to(x[:, None, :], (x.shape[0], t, x.shape[1]))
+
+    def n_inputs(self):
+        return 2
+
+    def output_type(self, input_types):
+        f = input_types[0]
+        ref = input_types[1]
+        tlen = ref.timeseries_length if isinstance(ref, RecurrentType) else None
+        return RecurrentType(size=_feature_size(f), timeseries_length=tlen)

@@ -392,6 +392,13 @@ class MultiLayerNetwork:
                     f"{type(layer).__name__} does not support rnn_time_step "
                     "(needs the full sequence)")
         x = self._cast_features(x)
+        if self._rnn_carry is not None:
+            for carry in self._rnn_carry:
+                if "h" in carry and carry["h"].shape[0] != x.shape[0]:
+                    raise ValueError(
+                        f"rnn_time_step batch size {x.shape[0]} != stored "
+                        f"state batch size {carry['h'].shape[0]}; call "
+                        "rnn_clear_previous_state() between sequences")
         self._seed_recurrent_states(x.shape[0])
         out, new_state = self._rnn_step_fn(
             self.params_tree, self._merged_state(), x)

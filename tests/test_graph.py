@@ -1,0 +1,280 @@
+"""ComputationGraph tests (reference TestComputationGraphNetwork,
+GradientCheckTestsComputationGraph)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (Adam, ComputationGraph, DenseLayer,
+                                ElementWiseVertex, GravesLSTM, InputType,
+                                LastTimeStepVertex, MergeVertex,
+                                MultiLayerNetwork, NeuralNetConfiguration,
+                                OutputLayer, RnnOutputLayer, Sgd, SubsetVertex)
+from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.nn.conf.graph_conf import ComputationGraphConfiguration
+from deeplearning4j_tpu.nn.graph.vertices import (DuplicateToTimeSeriesVertex,
+                                                  L2NormalizeVertex,
+                                                  ScaleVertex, StackVertex,
+                                                  UnstackVertex)
+from deeplearning4j_tpu.utils.gradient_check import gradient_check_fn
+
+
+def _data(n=32, f=8, c=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, f)).astype(np.float32)
+    y = np.eye(c, dtype=np.float32)[rng.integers(0, c, n)]
+    return x, y
+
+
+class TestGraphBasics:
+    def test_linear_graph_equals_mln(self):
+        """A chain graph must train identically to the equivalent
+        MultiLayerNetwork (reference TestComputationGraphNetwork's
+        MLN-vs-graph equivalence)."""
+        x, y = _data()
+
+        def layers():
+            return (DenseLayer(n_out=16, activation="tanh"),
+                    OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+
+        d1, o1 = layers()
+        mln_conf = (NeuralNetConfiguration.builder().seed(5).updater(Sgd(0.1))
+                    .list().layer(d1).layer(o1)
+                    .set_input_type(InputType.feed_forward(8)).build())
+        mln = MultiLayerNetwork(mln_conf).init()
+
+        d2, o2 = layers()
+        g_conf = (NeuralNetConfiguration.builder().seed(5).updater(Sgd(0.1))
+                  .graph_builder()
+                  .add_inputs("in")
+                  .add_layer("dense", d2, "in")
+                  .add_layer("out", o2, "dense")
+                  .set_outputs("out")
+                  .set_input_types(InputType.feed_forward(8))
+                  .build())
+        graph = ComputationGraph(g_conf).init()
+
+        np.testing.assert_allclose(mln.output(x), graph.output(x), rtol=1e-5)
+        for _ in range(5):
+            mln._fit_batch(DataSet(x, y))
+            graph.fit_batch(MultiDataSet([x], [y]))
+        np.testing.assert_allclose(float(mln.score_value),
+                                   float(graph.score_value), rtol=1e-5)
+        np.testing.assert_allclose(mln.output(x), graph.output(x), rtol=1e-4)
+
+    def test_skip_connection_learns(self):
+        x, y = _data(64)
+        conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(0.01))
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("d1", DenseLayer(n_out=8, activation="relu"), "in")
+                .add_vertex("skip", ElementWiseVertex(op="add"), "d1", "in")
+                .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                              loss="mcxent"), "skip")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(8))
+                .build())
+        g = ComputationGraph(conf).init()
+        s0 = None
+        for i in range(30):
+            g.fit_batch(MultiDataSet([x], [y]))
+            if i == 0:
+                s0 = float(g.score_value)
+        assert float(g.score_value) < s0
+
+    def test_merge_two_inputs(self):
+        rng = np.random.default_rng(0)
+        xa = rng.standard_normal((16, 4)).astype(np.float32)
+        xb = rng.standard_normal((16, 6)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)]
+        conf = (NeuralNetConfiguration.builder().seed(2).updater(Sgd(0.1))
+                .graph_builder()
+                .add_inputs("a", "b")
+                .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                              loss="mcxent"), "a", "b")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(4),
+                                 InputType.feed_forward(6))
+                .build())
+        g = ComputationGraph(conf).init()
+        # implicit merge: out layer sees 10 features
+        assert conf.nodes["out-merge"].vertex is not None
+        assert g.output(xa, xb).shape == (16, 2)
+        g.fit_batch(MultiDataSet([xa, xb], [y]))
+
+    def test_multi_output(self):
+        x, _ = _data(16)
+        rng = np.random.default_rng(1)
+        y1 = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+        y2 = rng.standard_normal((16, 2)).astype(np.float32)
+        conf = (NeuralNetConfiguration.builder().seed(2).updater(Sgd(0.05))
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("trunk", DenseLayer(n_out=12, activation="tanh"),
+                           "in")
+                .add_layer("cls", OutputLayer(n_out=3, activation="softmax",
+                                              loss="mcxent"), "trunk")
+                .add_layer("reg", OutputLayer(n_out=2, activation="identity",
+                                              loss="mse"), "trunk")
+                .set_outputs("cls", "reg")
+                .set_input_types(InputType.feed_forward(8))
+                .build())
+        g = ComputationGraph(conf).init()
+        outs = g.outputs(x)
+        assert outs[0].shape == (16, 3) and outs[1].shape == (16, 2)
+        s = None
+        for i in range(20):
+            g.fit_batch(MultiDataSet([x], [y1, y2]))
+            if i == 0:
+                s = float(g.score_value)
+        assert float(g.score_value) < s
+
+
+class TestVertices:
+    def test_subset_scale_l2norm(self):
+        x = np.arange(12, dtype=np.float32).reshape(2, 6)
+        conf = (NeuralNetConfiguration.builder().graph_builder()
+                .add_inputs("in")
+                .add_vertex("sub", SubsetVertex(from_idx=1, to_idx=3), "in")
+                .add_vertex("sc", ScaleVertex(scale_factor=2.0), "sub")
+                .add_layer("out", OutputLayer(n_out=2, activation="identity",
+                                              loss="mse", n_in=3), "sc")
+                .set_outputs("out")
+                .build())
+        g = ComputationGraph(conf).init()
+        acts, _, _, _ = g._walk(g.params_tree, g.state_tree,
+                                {"in": jnp.asarray(x)}, False, None, {})
+        np.testing.assert_allclose(np.asarray(acts["sub"]), x[:, 1:4])
+        np.testing.assert_allclose(np.asarray(acts["sc"]), 2 * x[:, 1:4])
+
+    def test_stack_unstack(self):
+        x = np.arange(8, dtype=np.float32).reshape(4, 2)
+        conf = (NeuralNetConfiguration.builder().graph_builder()
+                .add_inputs("a", "b")
+                .add_vertex("stack", StackVertex(), "a", "b")
+                .add_vertex("u0", UnstackVertex(from_idx=0, stack_size=2),
+                            "stack")
+                .add_layer("out", OutputLayer(n_out=1, activation="identity",
+                                              loss="mse", n_in=2), "u0")
+                .set_outputs("out")
+                .build())
+        g = ComputationGraph(conf).init()
+        acts, _, _, _ = g._walk(
+            g.params_tree, g.state_tree,
+            {"a": jnp.asarray(x), "b": jnp.asarray(x + 10)}, False, None, {})
+        assert acts["stack"].shape == (8, 2)
+        np.testing.assert_allclose(np.asarray(acts["u0"]), x)
+
+    def test_last_time_step_masked(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((3, 5, 4)).astype(np.float32)
+        mask = np.ones((3, 5), np.float32)
+        mask[1, 3:] = 0.0  # example 1 has length 3
+        conf = (NeuralNetConfiguration.builder().seed(4).updater(Sgd(0.1))
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("lstm", GravesLSTM(n_out=6, activation="tanh"),
+                           "in")
+                .add_vertex("last", LastTimeStepVertex(mask_input="in"),
+                            "lstm")
+                .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                              loss="mcxent"), "last")
+                .set_outputs("out")
+                .set_input_types(InputType.recurrent(4))
+                .build())
+        g = ComputationGraph(conf).init()
+        out = g.output(x, features_masks=[mask])
+        assert out.shape == (3, 2)
+        # masked example: last step == step 2 output of the truncated seq
+        out_trunc = g.output(x[:, :3], features_masks=[mask[:, :3]])
+        np.testing.assert_allclose(out[1], out_trunc[1], rtol=1e-5)
+
+    def test_seq2seq_duplicate_vertex(self):
+        """Encoder-decoder wiring: LastTimeStep -> DuplicateToTimeSeries
+        (reference seq2seq graph pattern)."""
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((4, 6, 5)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (4, 6))]
+        conf = (NeuralNetConfiguration.builder().seed(4).updater(Adam(0.01))
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("enc", GravesLSTM(n_out=7, activation="tanh"), "in")
+                .add_vertex("last", LastTimeStepVertex(mask_input="in"), "enc")
+                .add_vertex("dup", DuplicateToTimeSeriesVertex(
+                    reference_input="in"), "last", "in")
+                .add_layer("dec", GravesLSTM(n_out=7, activation="tanh"),
+                           "dup")
+                .add_layer("out", RnnOutputLayer(n_out=3, activation="softmax",
+                                                 loss="mcxent"), "dec")
+                .set_outputs("out")
+                .set_input_types(InputType.recurrent(5))
+                .build())
+        g = ComputationGraph(conf).init()
+        assert g.output(x).shape == (4, 6, 3)
+        g.fit_batch(MultiDataSet([x], [y]))
+
+
+class TestGraphGradients:
+    def test_gradient_check_dag(self):
+        jax.config.update("jax_enable_x64", True)
+        try:
+            x, y = _data(4, 5, 2, seed=3)
+            conf = (NeuralNetConfiguration.builder().seed(6).updater(Sgd(0.1))
+                    .graph_builder()
+                    .add_inputs("in")
+                    .add_layer("d1", DenseLayer(n_out=6, activation="tanh"),
+                               "in")
+                    .add_layer("d2", DenseLayer(n_out=6, activation="sigmoid"),
+                               "in")
+                    .add_vertex("ew", ElementWiseVertex(op="add"), "d1", "d2")
+                    .add_vertex("norm", L2NormalizeVertex(), "ew")
+                    .add_layer("out", OutputLayer(n_out=2,
+                                                  activation="softmax",
+                                                  loss="mcxent"), "norm",
+                               preprocessor=None)
+                    .set_outputs("out")
+                    .set_input_types(InputType.feed_forward(5))
+                    .build())
+            g = ComputationGraph(conf).init(dtype=jnp.float64)
+            xs = {"in": jnp.asarray(x, jnp.float64)}
+            ys = {"out": jnp.asarray(y, jnp.float64)}
+
+            def loss(params):
+                return g._loss_pure(params, g.state_tree, xs, ys, {}, {},
+                                    None, False)[0]
+
+            assert gradient_check_fn(loss, g.params_tree, max_params=60)
+        finally:
+            jax.config.update("jax_enable_x64", False)
+
+
+class TestGraphConfig:
+    def test_json_roundtrip(self):
+        conf = (NeuralNetConfiguration.builder().seed(2).updater(Adam(0.01))
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("d", DenseLayer(n_out=4, activation="relu"), "in")
+                .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                              loss="mcxent"), "d")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(3))
+                .build())
+        s = conf.to_json()
+        back = ComputationGraphConfiguration.from_json(s)
+        assert back.topo_order == conf.topo_order
+        assert back.nodes["d"].layer.n_in == 3
+        g = ComputationGraph(back).init()
+        assert g.output(np.zeros((2, 3), np.float32)).shape == (2, 2)
+
+    def test_cycle_detection(self):
+        b = (NeuralNetConfiguration.builder().graph_builder()
+             .add_inputs("in"))
+        b._nodes = {}
+        from deeplearning4j_tpu.nn.conf.graph_conf import GraphNode
+        b._nodes["a"] = GraphNode(inputs=["b"],
+                                  layer=DenseLayer(n_out=2, n_in=2))
+        b._nodes["b"] = GraphNode(inputs=["a"],
+                                  layer=DenseLayer(n_out=2, n_in=2))
+        b._outputs = ["a"]
+        with pytest.raises(ValueError, match="cycle"):
+            b.build()
