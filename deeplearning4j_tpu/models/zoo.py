@@ -1,0 +1,530 @@
+"""Model zoo: standard architectures as config builders.
+
+Reference parity: deeplearning4j-zoo zoo/model/{LeNet,SimpleCNN,AlexNet,
+VGG16,VGG19,ResNet50,GoogLeNet,TextGenerationLSTM}.java and
+zoo/ZooModel.java (init()/initPretrained() contract, zoo/ZooModel.java:28-81).
+
+Documented divergences from the reference (all deliberate):
+  * Input shape convention is NHWC [height, width, channels] (TPU layout),
+    not the reference's [channels, height, width].
+  * SimpleCNN's reference build ends at a softmax ActivationLayer with no
+    loss head (SimpleCNN.java:125-127, untrainable as-built); here the tail
+    is a LossLayer(softmax, mcxent) so fit() works — same math, trainable.
+  * GoogLeNet's inception pool branch uses SAME-padded 3x3/1 pooling (the
+    published GoogLeNet; the reference's unpadded pool cannot merge).
+  * initPretrained(): this environment has no egress; pretrained weights
+    load from a local file via ModelSerializer/Keras import instead of the
+    reference's URL+checksum download (zoo/ZooModel.java:40-81).
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from ..nn.conf.builders import (BackpropType, MultiLayerConfiguration,
+                                NeuralNetConfiguration)
+from ..nn.conf.graph_conf import ComputationGraphConfiguration
+from ..nn.conf.inputs import InputType
+from ..nn.graph import ComputationGraph, ElementWiseVertex, MergeVertex
+from ..nn.layers.convolution import (BatchNormalization, ConvolutionLayer,
+                                     ConvolutionMode, GlobalPoolingLayer,
+                                     LocalResponseNormalization, PoolingType,
+                                     SubsamplingLayer, ZeroPaddingLayer)
+from ..nn.layers.core import (ActivationLayer, DenseLayer, DropoutLayer,
+                              LossLayer, OutputLayer)
+from ..nn.layers.recurrent import GravesLSTM, RnnOutputLayer
+from ..nn.multilayer import MultiLayerNetwork
+from ..nn.updaters import (AdaDelta, GradientNormalization, Nesterovs, RmsProp,
+                           Sgd)
+from ..nn.weights import Distribution, WeightInit
+
+
+@dataclass
+class ZooModel:
+    """Base zoo model (reference zoo/ZooModel.java)."""
+
+    num_labels: int = 1000
+    seed: int = 123
+    input_shape: Sequence[int] = (224, 224, 3)  # NHWC
+
+    def conf(self):
+        raise NotImplementedError
+
+    def init(self, **init_kwargs):
+        """Build + initialize the network (reference ZooModel.init).
+        Extra kwargs (e.g. dtype=jnp.bfloat16) pass through to network
+        init()."""
+        c = self.conf()
+        if isinstance(c, ComputationGraphConfiguration):
+            return ComputationGraph(c).init(**init_kwargs)
+        return MultiLayerNetwork(c).init(**init_kwargs)
+
+    def init_pretrained(self, path: str):
+        """Load pretrained weights from a local checkpoint (reference
+        initPretrained downloads by URL+checksum, ZooModel.java:40-81; this
+        environment is zero-egress so weights come from a file)."""
+        try:
+            from ..utils.model_serializer import restore_model
+        except ImportError as e:
+            raise NotImplementedError(
+                "Checkpoint loading (utils.model_serializer) is not built "
+                "yet; coming with the ModelSerializer milestone") from e
+        return restore_model(path)
+
+
+# --------------------------------------------------------------------------
+# MultiLayerNetwork models
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class LeNet(ZooModel):
+    """Reference zoo/model/LeNet.java:81-110: conv5x5x20 → max2 → conv5x5x50
+    → max2 → dense500 → softmax; AdaDelta, XAVIER, Same mode."""
+
+    num_labels: int = 10
+    input_shape: Sequence[int] = (28, 28, 1)
+
+    def conf(self) -> MultiLayerConfiguration:
+        h, w, c = self.input_shape
+        return (NeuralNetConfiguration.builder()
+                .seed(self.seed)
+                .activation("identity")
+                .weight_init(WeightInit.XAVIER)
+                .updater(AdaDelta())
+                .list()
+                .layer(ConvolutionLayer(kernel_size=(5, 5), stride=(1, 1),
+                                        n_out=20, activation="relu",
+                                        convolution_mode=ConvolutionMode.SAME))
+                .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2),
+                                        pooling_type=PoolingType.MAX,
+                                        convolution_mode=ConvolutionMode.SAME))
+                .layer(ConvolutionLayer(kernel_size=(5, 5), stride=(1, 1),
+                                        n_out=50, activation="relu",
+                                        convolution_mode=ConvolutionMode.SAME))
+                .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2),
+                                        pooling_type=PoolingType.MAX,
+                                        convolution_mode=ConvolutionMode.SAME))
+                .layer(DenseLayer(n_out=500, activation="relu"))
+                .layer(OutputLayer(n_out=self.num_labels,
+                                   activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.convolutional(h, w, c))
+                .build())
+
+
+@dataclass
+class SimpleCNN(ZooModel):
+    """Reference zoo/model/SimpleCNN.java:75-128: VGG-ish conv/BN stack with
+    AVG pools + dropout, ending in conv(numLabels) → global avg pool →
+    softmax (here with mcxent LossLayer so it trains)."""
+
+    num_labels: int = 10
+    input_shape: Sequence[int] = (48, 48, 1)
+
+    def conf(self) -> MultiLayerConfiguration:
+        h, w, c = self.input_shape
+        b = (NeuralNetConfiguration.builder()
+             .seed(self.seed)
+             .activation("identity")
+             .weight_init(WeightInit.RELU)
+             .updater(AdaDelta())
+             .convolution_mode(ConvolutionMode.SAME)
+             .gradient_normalization(
+                 GradientNormalization.RENORMALIZE_L2_PER_LAYER)
+             .list())
+
+        def block(k, n, relu_after=True):
+            b.layer(ConvolutionLayer(kernel_size=(k, k), n_out=n))
+            b.layer(BatchNormalization())
+
+        block(7, 16)
+        block(7, 16)
+        b.layer(ActivationLayer(activation="relu"))
+        b.layer(SubsamplingLayer(kernel_size=(2, 2),
+                                 pooling_type=PoolingType.AVG))
+        b.layer(DropoutLayer(dropout_rate=0.5))
+        block(5, 32)
+        block(5, 32)
+        b.layer(ActivationLayer(activation="relu"))
+        b.layer(SubsamplingLayer(kernel_size=(2, 2),
+                                 pooling_type=PoolingType.AVG))
+        b.layer(DropoutLayer(dropout_rate=0.5))
+        block(3, 64)
+        block(3, 64)
+        b.layer(ActivationLayer(activation="relu"))
+        b.layer(SubsamplingLayer(kernel_size=(2, 2),
+                                 pooling_type=PoolingType.AVG))
+        b.layer(DropoutLayer(dropout_rate=0.5))
+        block(3, 128)
+        block(3, 128)
+        b.layer(ActivationLayer(activation="relu"))
+        b.layer(SubsamplingLayer(kernel_size=(2, 2),
+                                 pooling_type=PoolingType.AVG))
+        b.layer(DropoutLayer(dropout_rate=0.5))
+        b.layer(ConvolutionLayer(kernel_size=(3, 3), n_out=256))
+        b.layer(BatchNormalization())
+        b.layer(ConvolutionLayer(kernel_size=(3, 3), n_out=self.num_labels))
+        b.layer(GlobalPoolingLayer(pooling_type=PoolingType.AVG))
+        b.layer(LossLayer(activation="softmax", loss="mcxent"))
+        return b.set_input_type(InputType.convolutional(h, w, c)).build()
+
+
+@dataclass
+class AlexNet(ZooModel):
+    """Reference zoo/model/AlexNet.java:84-130 (one-tower AlexNet, Krizhevsky
+    2014 weights/biases: gaussian(0, 0.01) init, bias 1 on conv2/4/5 and
+    dense, dropout 0.5, Nesterov momentum, L2 5e-4, LRN)."""
+
+    num_labels: int = 1000
+    input_shape: Sequence[int] = (224, 224, 3)
+
+    def conf(self) -> MultiLayerConfiguration:
+        h, w, c = self.input_shape
+        bias1 = 1.0
+        return (NeuralNetConfiguration.builder()
+                .seed(self.seed)
+                .weight_init(WeightInit.DISTRIBUTION)
+                .dist(Distribution(kind="normal", mean=0.0, std=0.01))
+                .activation("relu")
+                .updater(Nesterovs(learning_rate=1e-2, momentum=0.9))
+                .convolution_mode(ConvolutionMode.SAME)
+                .gradient_normalization(
+                    GradientNormalization.RENORMALIZE_L2_PER_LAYER)
+                .dropout(0.5)
+                .l2(5e-4)
+                .list()
+                # conv1/maxpool1/conv2 are explicitly Truncate in the
+                # reference (AlexNet.java:99-105); the rest inherit Same.
+                .layer(ConvolutionLayer(
+                    kernel_size=(11, 11), stride=(4, 4), padding=(2, 2),
+                    n_out=64, dropout_rate=0.0,
+                    convolution_mode=ConvolutionMode.TRUNCATE))
+                .layer(LocalResponseNormalization(dropout_rate=0.0))
+                .layer(SubsamplingLayer(kernel_size=(3, 3), stride=(2, 2),
+                                        padding=(1, 1),
+                                        pooling_type=PoolingType.MAX,
+                                        convolution_mode=ConvolutionMode.TRUNCATE,
+                                        dropout_rate=0.0))
+                .layer(ConvolutionLayer(kernel_size=(5, 5), stride=(2, 2),
+                                        padding=(2, 2), n_out=192,
+                                        bias_init=bias1, dropout_rate=0.0,
+                                        convolution_mode=ConvolutionMode.TRUNCATE))
+                .layer(LocalResponseNormalization(dropout_rate=0.0))
+                .layer(SubsamplingLayer(kernel_size=(3, 3), stride=(2, 2),
+                                        pooling_type=PoolingType.MAX,
+                                        dropout_rate=0.0))
+                .layer(ConvolutionLayer(kernel_size=(3, 3), stride=(1, 1),
+                                        n_out=384, dropout_rate=0.0))
+                .layer(ConvolutionLayer(kernel_size=(3, 3), stride=(1, 1),
+                                        n_out=256,
+                                        bias_init=bias1, dropout_rate=0.0))
+                .layer(ConvolutionLayer(kernel_size=(3, 3), stride=(1, 1),
+                                        n_out=256,
+                                        bias_init=bias1, dropout_rate=0.0))
+                .layer(SubsamplingLayer(kernel_size=(3, 3), stride=(7, 7),
+                                        pooling_type=PoolingType.MAX,
+                                        dropout_rate=0.0))
+                .layer(DenseLayer(n_out=4096, bias_init=bias1,
+                                  dist=Distribution(kind="normal", std=0.005),
+                                  weight_init=WeightInit.DISTRIBUTION))
+                .layer(DenseLayer(n_out=4096, bias_init=bias1,
+                                  dist=Distribution(kind="normal", std=0.005),
+                                  weight_init=WeightInit.DISTRIBUTION))
+                .layer(OutputLayer(n_out=self.num_labels,
+                                   activation="softmax",
+                                   loss="negativeloglikelihood"))
+                .set_input_type(InputType.convolutional(h, w, c))
+                .build())
+
+
+def _vgg_conf(builder, conv_plan, num_labels, input_shape):
+    h, w, c = input_shape
+    for n in conv_plan:
+        if n == "M":
+            builder.layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2),
+                                           pooling_type=PoolingType.MAX))
+        else:
+            builder.layer(ConvolutionLayer(kernel_size=(3, 3), stride=(1, 1),
+                                           padding=(1, 1), n_out=n))
+    builder.layer(OutputLayer(n_out=num_labels, activation="softmax",
+                              loss="negativeloglikelihood"))
+    return builder.set_input_type(InputType.convolutional(h, w, c)).build()
+
+
+@dataclass
+class VGG16(ZooModel):
+    """Reference zoo/model/VGG16.java:90-160 (dense tail commented out in
+    the reference too — conv stack straight into the output layer)."""
+
+    def conf(self) -> MultiLayerConfiguration:
+        b = (NeuralNetConfiguration.builder().seed(self.seed)
+             .activation("relu").updater(Nesterovs(learning_rate=1e-2))
+             .weight_init(WeightInit.XAVIER).list())
+        plan = [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+                512, 512, 512, "M", 512, 512, 512, "M"]
+        return _vgg_conf(b, plan, self.num_labels, self.input_shape)
+
+
+@dataclass
+class VGG19(ZooModel):
+    """Reference zoo/model/VGG19.java:80-150."""
+
+    def conf(self) -> MultiLayerConfiguration:
+        b = (NeuralNetConfiguration.builder().seed(self.seed)
+             .activation("relu").updater(Nesterovs(learning_rate=1e-2))
+             .weight_init(WeightInit.XAVIER).list())
+        plan = [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+                512, 512, 512, 512, "M", 512, 512, 512, 512, "M"]
+        return _vgg_conf(b, plan, self.num_labels, self.input_shape)
+
+
+@dataclass
+class TextGenerationLSTM(ZooModel):
+    """Reference zoo/model/TextGenerationLSTM.java:77-97: two GravesLSTM(256)
+    + RnnOutput(mcxent), RmsProp, l2 1e-3, tBPTT 50."""
+
+    num_labels: int = 26  # totalUniqueCharacters
+    input_shape: Sequence[int] = (50, 26)  # [maxLen, vocab]
+    hidden: int = 256
+
+    def conf(self) -> MultiLayerConfiguration:
+        return (NeuralNetConfiguration.builder()
+                .seed(self.seed)
+                .l2(0.001)
+                .weight_init(WeightInit.XAVIER)
+                .updater(RmsProp(learning_rate=0.1))
+                .list()
+                .layer(GravesLSTM(n_out=self.hidden, activation="tanh"))
+                .layer(GravesLSTM(n_out=self.hidden, activation="tanh"))
+                .layer(RnnOutputLayer(n_out=self.num_labels,
+                                      activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.recurrent(self.input_shape[1]))
+                .backprop_type(BackpropType.TRUNCATED_BPTT)
+                .tbptt_fwd_length(50).tbptt_back_length(50)
+                .build())
+
+
+# --------------------------------------------------------------------------
+# ComputationGraph models
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class ResNet50(ZooModel):
+    """Reference zoo/model/ResNet50.java:82-230: stem (zeropad3, conv7x7/2,
+    BN, relu, maxpool3x3/2) + conv/identity bottleneck blocks per stage,
+    RmsProp(0.1, 0.96), normal(0, 0.5) init, l1 1e-7 l2 5e-5."""
+
+    def _bn_act(self, g, name, inp, act="relu"):
+        g.add_layer("bn" + name, BatchNormalization(), inp)
+        g.add_layer("act" + name, ActivationLayer(activation=act),
+                    "bn" + name)
+        return "act" + name
+
+    def _identity_block(self, g, kernel, filters, stage, block, inp):
+        f1, f2, f3 = filters
+        base = f"{stage}{block}_branch"
+        g.add_layer(f"res{base}2a", ConvolutionLayer(
+            kernel_size=(1, 1), n_out=f1), inp)
+        a = self._bn_act(g, f"{base}2a", f"res{base}2a")
+        g.add_layer(f"res{base}2b", ConvolutionLayer(
+            kernel_size=kernel, n_out=f2,
+            convolution_mode=ConvolutionMode.SAME), a)
+        a = self._bn_act(g, f"{base}2b", f"res{base}2b")
+        g.add_layer(f"res{base}2c", ConvolutionLayer(
+            kernel_size=(1, 1), n_out=f3), a)
+        g.add_layer(f"bn{base}2c", BatchNormalization(), f"res{base}2c")
+        g.add_vertex(f"short{base}", ElementWiseVertex(op="add"),
+                     f"bn{base}2c", inp)
+        g.add_layer(f"res{stage}{block}_out",
+                    ActivationLayer(activation="relu"), f"short{base}")
+        return f"res{stage}{block}_out"
+
+    def _conv_block(self, g, kernel, filters, stage, block, inp,
+                    stride=(2, 2)):
+        f1, f2, f3 = filters
+        base = f"{stage}{block}_branch"
+        g.add_layer(f"res{base}2a", ConvolutionLayer(
+            kernel_size=(1, 1), stride=stride, n_out=f1), inp)
+        a = self._bn_act(g, f"{base}2a", f"res{base}2a")
+        g.add_layer(f"res{base}2b", ConvolutionLayer(
+            kernel_size=kernel, n_out=f2,
+            convolution_mode=ConvolutionMode.SAME), a)
+        a = self._bn_act(g, f"{base}2b", f"res{base}2b")
+        g.add_layer(f"res{base}2c", ConvolutionLayer(
+            kernel_size=(1, 1), n_out=f3), a)
+        g.add_layer(f"bn{base}2c", BatchNormalization(), f"res{base}2c")
+        # projection shortcut
+        g.add_layer(f"res{base}1", ConvolutionLayer(
+            kernel_size=(1, 1), stride=stride, n_out=f3), inp)
+        g.add_layer(f"bn{base}1", BatchNormalization(), f"res{base}1")
+        g.add_vertex(f"short{base}", ElementWiseVertex(op="add"),
+                     f"bn{base}2c", f"bn{base}1")
+        g.add_layer(f"res{stage}{block}_out",
+                    ActivationLayer(activation="relu"), f"short{base}")
+        return f"res{stage}{block}_out"
+
+    def conf(self) -> ComputationGraphConfiguration:
+        h, w, c = self.input_shape
+        g = (NeuralNetConfiguration.builder()
+             .seed(self.seed)
+             .activation("identity")
+             .updater(RmsProp(learning_rate=0.1, rms_decay=0.96,
+                              epsilon=0.001))
+             .weight_init(WeightInit.DISTRIBUTION)
+             .dist(Distribution(kind="normal", mean=0.0, std=0.5))
+             .l1(1e-7).l2(5e-5)
+             .graph_builder())
+        g.add_inputs("input")
+        g.set_input_types(InputType.convolutional(h, w, c))
+        g.add_layer("stem-zero", ZeroPaddingLayer(padding=(3, 3)), "input")
+        g.add_layer("stem-cnn1", ConvolutionLayer(
+            kernel_size=(7, 7), stride=(2, 2), n_out=64), "stem-zero")
+        a = self._bn_act(g, "stem1", "stem-cnn1")
+        g.add_layer("stem-maxpool1", SubsamplingLayer(
+            kernel_size=(3, 3), stride=(2, 2),
+            pooling_type=PoolingType.MAX), a)
+
+        x = self._conv_block(g, (3, 3), (64, 64, 256), "2", "a",
+                             "stem-maxpool1", stride=(2, 2))
+        x = self._identity_block(g, (3, 3), (64, 64, 256), "2", "b", x)
+        x = self._identity_block(g, (3, 3), (64, 64, 256), "2", "c", x)
+
+        x = self._conv_block(g, (3, 3), (128, 128, 512), "3", "a", x)
+        for blk in "bcd":
+            x = self._identity_block(g, (3, 3), (128, 128, 512), "3", blk, x)
+
+        x = self._conv_block(g, (3, 3), (256, 256, 1024), "4", "a", x)
+        for blk in "bcdef":
+            x = self._identity_block(g, (3, 3), (256, 256, 1024), "4", blk, x)
+
+        x = self._conv_block(g, (3, 3), (512, 512, 2048), "5", "a", x)
+        x = self._identity_block(g, (3, 3), (512, 512, 2048), "5", "b", x)
+        x = self._identity_block(g, (3, 3), (512, 512, 2048), "5", "c", x)
+
+        g.add_layer("avgpool", GlobalPoolingLayer(
+            pooling_type=PoolingType.AVG), x)
+        g.add_layer("output", OutputLayer(
+            n_out=self.num_labels, activation="softmax",
+            loss="negativeloglikelihood"), "avgpool")
+        g.set_outputs("output")
+        return g.build()
+
+
+@dataclass
+class GoogLeNet(ZooModel):
+    """Reference zoo/model/GoogLeNet.java:83-180 (Szegedy et al. inception
+    v1; Nesterovs(1e-2, 0.9), l2 2e-4 relu)."""
+
+    def _inception(self, g, name, cfg, inp):
+        # cfg = [[c1x1], [c3r, c3], [c5r, c5], [pool_proj]]
+        g.add_layer(f"{name}-cnn1", ConvolutionLayer(
+            kernel_size=(1, 1), n_out=cfg[0][0], bias_init=0.2), inp)
+        g.add_layer(f"{name}-cnn2", ConvolutionLayer(
+            kernel_size=(1, 1), n_out=cfg[1][0], bias_init=0.2), inp)
+        g.add_layer(f"{name}-cnn3", ConvolutionLayer(
+            kernel_size=(1, 1), n_out=cfg[2][0], bias_init=0.2), inp)
+        g.add_layer(f"{name}-max1", SubsamplingLayer(
+            kernel_size=(3, 3), stride=(1, 1), pooling_type=PoolingType.MAX,
+            convolution_mode=ConvolutionMode.SAME), inp)
+        g.add_layer(f"{name}-cnn4", ConvolutionLayer(
+            kernel_size=(3, 3), padding=(1, 1), n_out=cfg[1][1],
+            bias_init=0.2), f"{name}-cnn2")
+        g.add_layer(f"{name}-cnn5", ConvolutionLayer(
+            kernel_size=(5, 5), padding=(2, 2), n_out=cfg[2][1],
+            bias_init=0.2), f"{name}-cnn3")
+        g.add_layer(f"{name}-cnn6", ConvolutionLayer(
+            kernel_size=(1, 1), n_out=cfg[3][0], bias_init=0.2),
+            f"{name}-max1")
+        g.add_vertex(f"{name}-depthconcat1", MergeVertex(),
+                     f"{name}-cnn1", f"{name}-cnn4", f"{name}-cnn5",
+                     f"{name}-cnn6")
+        return f"{name}-depthconcat1"
+
+    def conf(self) -> ComputationGraphConfiguration:
+        h, w, c = self.input_shape
+        g = (NeuralNetConfiguration.builder()
+             .seed(self.seed)
+             .activation("relu")
+             .updater(Nesterovs(learning_rate=1e-2, momentum=0.9))
+             .weight_init(WeightInit.XAVIER)
+             .l2(2e-4)
+             .graph_builder())
+        g.add_inputs("input")
+        g.set_input_types(InputType.convolutional(h, w, c))
+        g.add_layer("cnn1", ConvolutionLayer(
+            kernel_size=(7, 7), stride=(2, 2), padding=(3, 3), n_out=64,
+            bias_init=0.2), "input")
+        g.add_layer("max1", SubsamplingLayer(
+            kernel_size=(3, 3), stride=(2, 2), padding=(1, 1),
+            pooling_type=PoolingType.MAX), "cnn1")
+        g.add_layer("lrn1", LocalResponseNormalization(), "max1")
+        g.add_layer("cnn2", ConvolutionLayer(
+            kernel_size=(1, 1), n_out=64, bias_init=0.2), "lrn1")
+        g.add_layer("cnn3", ConvolutionLayer(
+            kernel_size=(3, 3), padding=(1, 1), n_out=192, bias_init=0.2),
+            "cnn2")
+        g.add_layer("lrn2", LocalResponseNormalization(), "cnn3")
+        g.add_layer("max2", SubsamplingLayer(
+            kernel_size=(3, 3), stride=(2, 2), padding=(1, 1),
+            pooling_type=PoolingType.MAX), "lrn2")
+
+        x = self._inception(g, "3a", [[64], [96, 128], [16, 32], [32]],
+                            "max2")
+        x = self._inception(g, "3b", [[128], [128, 192], [32, 96], [64]], x)
+        g.add_layer("max3", SubsamplingLayer(
+            kernel_size=(3, 3), stride=(2, 2), padding=(1, 1),
+            pooling_type=PoolingType.MAX), x)
+        x = self._inception(g, "4a", [[192], [96, 208], [16, 48], [64]],
+                            "max3")
+        x = self._inception(g, "4b", [[160], [112, 224], [24, 64], [64]], x)
+        x = self._inception(g, "4c", [[128], [128, 256], [24, 64], [64]], x)
+        x = self._inception(g, "4d", [[112], [144, 288], [32, 64], [64]], x)
+        x = self._inception(g, "4e", [[256], [160, 320], [32, 128], [128]], x)
+        g.add_layer("max4", SubsamplingLayer(
+            kernel_size=(3, 3), stride=(2, 2), padding=(1, 1),
+            pooling_type=PoolingType.MAX), x)
+        x = self._inception(g, "5a", [[256], [160, 320], [32, 128], [128]],
+                            "max4")
+        x = self._inception(g, "5b", [[384], [192, 384], [48, 128], [128]], x)
+        g.add_layer("avgpool", GlobalPoolingLayer(
+            pooling_type=PoolingType.AVG), x)
+        g.add_layer("fc1", DenseLayer(n_out=1024, dropout_rate=0.4), "avgpool")
+        g.add_layer("output", OutputLayer(
+            n_out=self.num_labels, activation="softmax", loss="mcxent"),
+            "fc1")
+        g.set_outputs("output")
+        return g.build()
+
+
+class ZooType(enum.Enum):
+    """Reference zoo/ZooType.java."""
+
+    LENET = "lenet"
+    SIMPLECNN = "simplecnn"
+    ALEXNET = "alexnet"
+    VGG16 = "vgg16"
+    VGG19 = "vgg19"
+    RESNET50 = "resnet50"
+    GOOGLENET = "googlenet"
+    TEXTGENLSTM = "textgenlstm"
+
+
+_ZOO = {
+    ZooType.LENET: LeNet,
+    ZooType.SIMPLECNN: SimpleCNN,
+    ZooType.ALEXNET: AlexNet,
+    ZooType.VGG16: VGG16,
+    ZooType.VGG19: VGG19,
+    ZooType.RESNET50: ResNet50,
+    ZooType.GOOGLENET: GoogLeNet,
+    ZooType.TEXTGENLSTM: TextGenerationLSTM,
+}
+
+
+def model_selector(zoo_type: ZooType, **kwargs) -> ZooModel:
+    """Instantiate a zoo model by type (reference zoo/ModelSelector.java)."""
+    if zoo_type not in _ZOO:
+        raise ValueError(f"Unknown zoo type {zoo_type}")
+    return _ZOO[zoo_type](**kwargs)

@@ -16,7 +16,7 @@ from __future__ import annotations
 import copy
 import enum
 from dataclasses import dataclass, field as dc_field
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 from ...utils import serde
 from ..layers.core import Layer
@@ -49,7 +49,7 @@ class OptimizationAlgorithm(enum.Enum):
 
 _INHERITABLE = ("activation", "weight_init", "dist", "bias_init", "l1", "l2",
                 "l1_bias", "l2_bias", "dropout_rate", "updater",
-                "gradient_normalization")
+                "gradient_normalization", "convolution_mode")
 
 
 def _preprocessor_for(layer: Layer, input_type: InputType):
@@ -241,6 +241,8 @@ class NeuralNetConfiguration:
     updater: Optional[Updater] = None
     gradient_normalization: Optional[GradientNormalization] = (
         GradientNormalization.NONE)
+    gradient_normalization_threshold: float = 1.0
+    convolution_mode: Optional[Any] = None  # ConvolutionMode; None=Truncate
     mini_batch: bool = True
     minimize: bool = True
     optimization_algo: OptimizationAlgorithm = (
@@ -255,8 +257,11 @@ class NeuralNetConfiguration:
         """Fill layer fields left as None with the global defaults
         (reference: NeuralNetConfiguration.Builder per-layer config clone)."""
         for f in _INHERITABLE:
-            if getattr(layer, f, None) is None:
+            if hasattr(layer, f) and getattr(layer, f) is None:
                 setattr(layer, f, copy.deepcopy(getattr(self, f)))
+                if f == "gradient_normalization":
+                    layer.gradient_normalization_threshold = (
+                        self.gradient_normalization_threshold)
         if layer.updater is None:
             layer.updater = Sgd(learning_rate=0.1)
         return layer
@@ -324,7 +329,13 @@ class NeuralNetConfigurationBuilder:
 
     def gradient_normalization(self, gn: GradientNormalization, threshold: float = 1.0):
         self._conf.gradient_normalization = gn
-        self._gn_threshold = threshold
+        self._conf.gradient_normalization_threshold = float(threshold)
+        return self
+
+    def convolution_mode(self, mode):
+        """Global default ConvolutionMode (reference
+        Builder.convolutionMode; inherited by conv/subsampling layers)."""
+        self._conf.convolution_mode = mode
         return self
 
     def optimization_algo(self, algo: OptimizationAlgorithm):

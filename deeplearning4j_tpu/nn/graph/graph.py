@@ -52,18 +52,23 @@ class ComputationGraph:
              ) -> "ComputationGraph":
         self._dtype = dtype
         base = jax.random.PRNGKey(self.conf.seed if seed is None else seed)
-        keys = jax.random.split(base, len(self._layer_nodes) + 1)
-        self.params_tree = {
-            name: self.conf.nodes[name].layer.init_params(k, dtype)
-            for name, k in zip(self._layer_nodes, keys[:-1])}
-        self.state_tree = {
-            name: self.conf.nodes[name].layer.init_state(dtype)
-            for name in self._layer_nodes}
-        self.opt_state = {
-            name: self.conf.nodes[name].layer.updater.init(
-                self.params_tree[name])
-            for name in self._layer_nodes}
-        self._rng = keys[-1]
+
+        # One jitted init (single device program; see MultiLayerNetwork.init)
+        def init_all(base_key):
+            keys = jax.random.split(base_key, len(self._layer_nodes) + 1)
+            params = {
+                name: self.conf.nodes[name].layer.init_params(k, dtype)
+                for name, k in zip(self._layer_nodes, keys[:-1])}
+            states = {
+                name: self.conf.nodes[name].layer.init_state(dtype)
+                for name in self._layer_nodes}
+            opt = {
+                name: self.conf.nodes[name].layer.updater.init(params[name])
+                for name in self._layer_nodes}
+            return params, states, opt, keys[-1]
+
+        (self.params_tree, self.state_tree, self.opt_state,
+         self._rng) = jax.jit(init_all)(base)
         self.iteration = 0
         self.epoch = 0
         self._build_jitted()
@@ -232,11 +237,12 @@ class ComputationGraph:
 
     # ------------------------------------------------------------------- fit
     def fit(self, data, labels=None, *, epochs: int = 1,
-            batch_size: int = 32) -> "ComputationGraph":
+            batch_size: int = 32, step_fn=None) -> "ComputationGraph":
         """Train (reference fit(MultiDataSetIterator):867). Accepts a
         MultiDataSet, DataSet, (features, labels) arrays, or an iterator of
-        either."""
+        either. `step_fn` lets ParallelWrapper substitute a sharded step."""
         self._check_init()
+        step = step_fn or self.fit_batch
         if self.conf.backprop_type == BackpropType.TRUNCATED_BPTT:
             raise NotImplementedError(
                 "tBPTT for ComputationGraph is not implemented yet; use "
@@ -249,7 +255,7 @@ class ComputationGraph:
                 iterator = list(iterator)
             for _ in range(epochs):
                 for ds in iterator:
-                    self.fit_batch(self._coerce(ds))
+                    step(self._coerce(ds))
                 self.epoch += 1
                 for lst in self.listeners:
                     if hasattr(lst, "on_epoch_end"):
@@ -267,7 +273,7 @@ class ComputationGraph:
                     [None if m is None else m[sl] for m in mds.features_masks],
                     None if mds.labels_masks is None else
                     [None if m is None else m[sl] for m in mds.labels_masks])
-                self.fit_batch(batch)
+                step(batch)
             self.epoch += 1
             for lst in self.listeners:
                 if hasattr(lst, "on_epoch_end"):
@@ -275,11 +281,17 @@ class ComputationGraph:
         return self
 
     def fit_batch(self, mds: MultiDataSet):
-        inputs, labels, fmasks, lmasks = self._pack(mds)
-        out = self._train_step_fn(
-            self.params_tree, self.opt_state, self.state_tree,
-            jnp.asarray(self.iteration, jnp.int32), self._rng,
-            inputs, labels, fmasks, lmasks)
+        self._run_and_commit(*self._pack(mds))
+
+    def _run_and_commit(self, inputs, labels, fmasks, lmasks, mesh=None):
+        """Invoke the jitted step and commit results + listeners (shared by
+        the single-device path and ParallelWrapper's sharded path)."""
+        import contextlib
+        with (mesh if mesh is not None else contextlib.nullcontext()):
+            out = self._train_step_fn(
+                self.params_tree, self.opt_state, self.state_tree,
+                jnp.asarray(self.iteration, jnp.int32), self._rng,
+                inputs, labels, fmasks, lmasks)
         (self.params_tree, self.opt_state, self.state_tree, _, self._rng,
          loss) = out
         self.iteration += 1

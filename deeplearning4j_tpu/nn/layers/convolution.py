@@ -84,12 +84,15 @@ class ConvolutionLayer(Layer):
     stride: Sequence[int] = (1, 1)
     padding: Sequence[int] = (0, 0)
     dilation: Sequence[int] = (1, 1)
-    convolution_mode: ConvolutionMode = ConvolutionMode.TRUNCATE
+    convolution_mode: Optional[ConvolutionMode] = None  # None -> inherit/Truncate
     # cuDNN-algo-mode analog: XLA autotunes; field kept for config parity.
     cudnn_algo_mode: str = "PREFER_FASTEST"
 
     def input_kind(self):
         return "cnn"
+
+    def _mode(self) -> ConvolutionMode:
+        return self.convolution_mode or ConvolutionMode.TRUNCATE
 
     def set_input_type(self, input_type):
         if not isinstance(input_type, ConvolutionalType):
@@ -100,10 +103,8 @@ class ConvolutionLayer(Layer):
         sh, sw = _pair(self.stride)
         ph, pw = _pair(self.padding)
         dh, dw = _pair(self.dilation)
-        oh = conv_output_size(input_type.height, kh, sh, ph,
-                              self.convolution_mode, dh)
-        ow = conv_output_size(input_type.width, kw, sw, pw,
-                              self.convolution_mode, dw)
+        oh = conv_output_size(input_type.height, kh, sh, ph, self._mode(), dh)
+        ow = conv_output_size(input_type.width, kw, sw, pw, self._mode(), dw)
         return ConvolutionalType(height=oh, width=ow, channels=self.n_out)
 
     def has_params(self):
@@ -120,7 +121,7 @@ class ConvolutionLayer(Layer):
     def _conv(self, x, w):
         sh, sw = _pair(self.stride)
         dh, dw = _pair(self.dilation)
-        if self.convolution_mode == ConvolutionMode.SAME:
+        if self._mode() == ConvolutionMode.SAME:
             pads = (_same_pads(x.shape[1], w.shape[0], sh, dh),
                     _same_pads(x.shape[2], w.shape[1], sw, dw))
         else:
@@ -163,7 +164,7 @@ class Convolution1DLayer(ConvolutionLayer):
         p = _pair(self.padding)[0]
         t = input_type.timeseries_length
         out_t = None if t is None else conv_output_size(
-            t, k, s, p, self.convolution_mode)
+            t, k, s, p, self._mode())
         return RecurrentType(size=self.n_out, timeseries_length=out_t)
 
     def forward(self, params, state, x, *, train=False, rng=None, mask=None):
@@ -183,7 +184,7 @@ class Convolution1DLayer(ConvolutionLayer):
     def _conv4d_1d(self, x, w):
         s = _pair(self.stride)[0]
         d = _pair(self.dilation)[0]
-        if self.convolution_mode == ConvolutionMode.SAME:
+        if self._mode() == ConvolutionMode.SAME:
             pads = (_same_pads(x.shape[1], w.shape[0], s, d), (0, 0))
         else:
             p = _pair(self.padding)[0]
@@ -212,12 +213,15 @@ class SubsamplingLayer(Layer):
     stride: Sequence[int] = (2, 2)
     padding: Sequence[int] = (0, 0)
     pooling_type: PoolingType = PoolingType.MAX
-    convolution_mode: ConvolutionMode = ConvolutionMode.TRUNCATE
+    convolution_mode: Optional[ConvolutionMode] = None  # None -> inherit/Truncate
     pnorm: int = 2
     eps: float = 1e-8
 
     def input_kind(self):
         return "cnn"
+
+    def _mode(self) -> ConvolutionMode:
+        return self.convolution_mode or ConvolutionMode.TRUNCATE
 
     def set_input_type(self, input_type):
         if not isinstance(input_type, ConvolutionalType):
@@ -225,15 +229,15 @@ class SubsamplingLayer(Layer):
         kh, kw = _pair(self.kernel_size)
         sh, sw = _pair(self.stride)
         ph, pw = _pair(self.padding)
-        oh = conv_output_size(input_type.height, kh, sh, ph, self.convolution_mode)
-        ow = conv_output_size(input_type.width, kw, sw, pw, self.convolution_mode)
+        oh = conv_output_size(input_type.height, kh, sh, ph, self._mode())
+        ow = conv_output_size(input_type.width, kw, sw, pw, self._mode())
         return ConvolutionalType(height=oh, width=ow, channels=input_type.channels)
 
     def forward(self, params, state, x, *, train=False, rng=None, mask=None):
         x = dropout(x, self.dropout_rate, train, rng)
         kh, kw = _pair(self.kernel_size)
         sh, sw = _pair(self.stride)
-        if self.convolution_mode == ConvolutionMode.SAME:
+        if self._mode() == ConvolutionMode.SAME:
             pads = ((0, 0), _same_pads(x.shape[1], kh, sh),
                     _same_pads(x.shape[2], kw, sw), (0, 0))
         else:
@@ -284,7 +288,7 @@ class Subsampling1DLayer(SubsamplingLayer):
         p = _pair(self.padding)[0]
         t = input_type.timeseries_length
         out_t = None if t is None else conv_output_size(
-            t, k, s, p, self.convolution_mode)
+            t, k, s, p, self._mode())
         return RecurrentType(size=input_type.size, timeseries_length=out_t)
 
     def forward(self, params, state, x, *, train=False, rng=None, mask=None):
@@ -295,7 +299,7 @@ class Subsampling1DLayer(SubsamplingLayer):
         p = _pair(self.padding)[0]
         layer2d = SubsamplingLayer(
             kernel_size=(k, 1), stride=(s, 1), padding=(p, 0),
-            pooling_type=self.pooling_type, convolution_mode=self.convolution_mode,
+            pooling_type=self.pooling_type, convolution_mode=self._mode(),
             pnorm=self.pnorm, eps=self.eps, dropout_rate=self.dropout_rate)
         out, _ = layer2d.forward(params, state, x4, train=train, rng=rng, mask=mask)
         return out[:, :, 0, :], state

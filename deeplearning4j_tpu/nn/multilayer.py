@@ -82,13 +82,20 @@ class MultiLayerNetwork:
         """Initialize parameters/optimizer state (reference init():442)."""
         self._dtype = dtype
         base = jax.random.PRNGKey(self.conf.seed if seed is None else seed)
-        keys = jax.random.split(base, len(self.layers) + 1)
-        self.params_tree = tuple(
-            layer.init_params(k, dtype) for layer, k in zip(self.layers, keys[:-1]))
-        self.state_tree = tuple(layer.init_state(dtype) for layer in self.layers)
-        self.opt_state = tuple(
-            layer.updater.init(p) for layer, p in zip(self.layers, self.params_tree))
-        self._rng = keys[-1]
+
+        # One jitted init: a single device program instead of hundreds of
+        # small eager dispatches (matters hugely on tunneled TPU backends).
+        def init_all(base_key):
+            keys = jax.random.split(base_key, len(self.layers) + 1)
+            params = tuple(layer.init_params(k, dtype)
+                           for layer, k in zip(self.layers, keys[:-1]))
+            states = tuple(layer.init_state(dtype) for layer in self.layers)
+            opt = tuple(layer.updater.init(p)
+                        for layer, p in zip(self.layers, params))
+            return params, states, opt, keys[-1]
+
+        (self.params_tree, self.state_tree, self.opt_state,
+         self._rng) = jax.jit(init_all)(base)
         self.iteration = 0
         self.epoch = 0
         self._build_jitted()

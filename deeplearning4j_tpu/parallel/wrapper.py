@@ -99,18 +99,30 @@ class ParallelWrapper:
         """Reuses the single-device epoch/listener loop with the sharded
         step substituted, so loop semantics can never diverge."""
         self.model._check_init()
-        self.model.fit(data, labels, epochs=epochs, batch_size=batch_size,
-                       async_queue_size=self.prefetch_buffer,
-                       step_fn=self.fit_batch)
+        if hasattr(self.model, "_pack"):  # ComputationGraph
+            self.model.fit(data, labels, epochs=epochs,
+                           batch_size=batch_size, step_fn=self.fit_batch)
+        else:
+            self.model.fit(data, labels, epochs=epochs, batch_size=batch_size,
+                           async_queue_size=self.prefetch_buffer,
+                           step_fn=self.fit_batch)
         return self
 
     def fit_batch(self, ds) -> None:
         """One globally-synchronous DP step (tBPTT windowing included, via
-        the net's own dispatch with our sharded step substituted)."""
+        the net's own dispatch with our sharded step substituted). Accepts a
+        DataSet for MultiLayerNetwork or a MultiDataSet/DataSet for
+        ComputationGraph."""
         net = self.model
         if not self._placed:
             net._check_init()
             self._place_model()
+        if hasattr(net, "_pack"):  # ComputationGraph
+            inputs, labels, fm, lm = net._pack(net._coerce(ds))
+            shard = lambda d: {k: self._shard_arr(v) for k, v in d.items()}
+            net._run_and_commit(shard(inputs), shard(labels), shard(fm),
+                                shard(lm), mesh=self.mesh)
+            return
         net._fit_batch(ds, do_step=self._sync_step)
 
     def _sync_step(self, x, y, fmask, lmask) -> None:

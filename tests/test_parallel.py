@@ -69,6 +69,24 @@ class TestParallelWrapper:
         assert net.iteration == 4
         assert net.epoch == 2
 
+    def test_graph_dp_fit(self):
+        """ParallelWrapper full-epoch training with a ComputationGraph."""
+        from deeplearning4j_tpu import ComputationGraph
+        conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(0.01))
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("d", DenseLayer(n_out=16, activation="relu"), "in")
+                .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                              loss="mcxent"), "d")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(8)).build())
+        g = ComputationGraph(conf).init()
+        ds = _data(64)
+        pw = ParallelWrapper(g, mesh=data_parallel_mesh(8))
+        pw.fit(ds, epochs=3, batch_size=32)
+        assert g.iteration == 6
+        assert np.isfinite(float(g.score_value))
+
     def test_padding_uneven_batch(self):
         ds = _data(30)  # not divisible by 8
         net = MultiLayerNetwork(_mlp_conf()).init()
@@ -83,7 +101,7 @@ class TestGraftEntry:
         import __graft_entry__ as g
         fn, args = g.entry()
         out = jax.jit(fn)(*args)
-        assert out.shape == (8, 10)
+        assert out.shape == (4, 10)
 
     def test_dryrun_multichip(self):
         import __graft_entry__ as g

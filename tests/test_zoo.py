@@ -1,0 +1,86 @@
+"""Zoo instantiation tests (reference zoo/TestInstantiation.java: every
+model builds, runs one fit step on random data, produces sane outputs)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.models import (AlexNet, GoogLeNet, LeNet, ResNet50,
+                                       SimpleCNN, TextGenerationLSTM, VGG16,
+                                       VGG19, ZooType, model_selector)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+
+def _img_data(n, h, w, c, classes, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, h, w, c)).astype(np.float32)
+    y = np.eye(classes, dtype=np.float32)[rng.integers(0, classes, n)]
+    return x, y
+
+
+def _check_mln(model, h, w, c, classes, batch=2):
+    net = model.init()
+    x, y = _img_data(batch, h, w, c, classes)
+    out = net.output(x)
+    assert out.shape == (batch, classes)
+    net.fit(DataSet(x, y), epochs=1, batch_size=batch, use_async=False)
+    assert np.isfinite(float(net.score_value))
+    return net
+
+
+class TestZooInstantiation:
+    def test_lenet(self):
+        net = _check_mln(LeNet(num_labels=10), 28, 28, 1, 10)
+        # 520 + 25,050 + (7*7*50)*500+500 + 5,010 (Same-mode LeNet)
+        assert net.num_params() == 1256080
+
+    def test_simplecnn(self):
+        _check_mln(SimpleCNN(num_labels=5, input_shape=(48, 48, 1)),
+                   48, 48, 1, 5)
+
+    def test_alexnet(self):
+        _check_mln(AlexNet(num_labels=5), 224, 224, 3, 5, batch=1)
+
+    def test_vgg16(self):
+        _check_mln(VGG16(num_labels=4, input_shape=(32, 32, 3)),
+                   32, 32, 3, 4, batch=1)
+
+    def test_vgg19(self):
+        _check_mln(VGG19(num_labels=4, input_shape=(32, 32, 3)),
+                   32, 32, 3, 4, batch=1)
+
+    def test_resnet50(self):
+        model = ResNet50(num_labels=6, input_shape=(64, 64, 3))
+        g = model.init()
+        assert isinstance(g, ComputationGraph)
+        x, y = _img_data(2, 64, 64, 3, 6)
+        out = g.output(x)
+        # NB: untrained eval-mode output explodes by design parity — the
+        # reference's normal(0, 0.5) init + eval-mode BN (running stats
+        # still 0/1) overflows too. Train mode (batch-stat BN) is finite.
+        assert out.shape == (2, 6)
+        g.fit_batch(MultiDataSet([x], [y]))
+        assert np.isfinite(float(g.score_value))
+
+    def test_googlenet(self):
+        model = GoogLeNet(num_labels=6, input_shape=(64, 64, 3))
+        g = model.init()
+        x, y = _img_data(2, 64, 64, 3, 6)
+        assert g.output(x).shape == (2, 6)
+        g.fit_batch(MultiDataSet([x], [y]))
+        assert np.isfinite(float(g.score_value))
+
+    def test_textgen_lstm(self):
+        model = TextGenerationLSTM(num_labels=12, input_shape=(10, 12))
+        net = model.init()
+        rng = np.random.default_rng(0)
+        x = np.eye(12, dtype=np.float32)[rng.integers(0, 12, (2, 10))]
+        y = np.eye(12, dtype=np.float32)[rng.integers(0, 12, (2, 10))]
+        assert net.output(x).shape == (2, 10, 12)
+        net._fit_batch(DataSet(x, y))
+        assert np.isfinite(float(net.score_value))
+
+    def test_model_selector(self):
+        m = model_selector(ZooType.LENET, num_labels=3)
+        assert isinstance(m, LeNet) and m.num_labels == 3
+        with pytest.raises(ValueError):
+            model_selector("nope")
