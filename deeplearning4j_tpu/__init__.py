@@ -33,5 +33,13 @@ from .data.dataset import DataSet, MultiDataSet
 from .data.iterators import (AsyncDataSetIterator, DataSetIterator,
                              ExistingDataSetIterator, ListDataSetIterator)
 from .eval.evaluation import Evaluation, EvaluationBinary, RegressionEvaluation
+from .nn.transfer_learning import (FineTuneConfiguration, TransferLearning,
+                                   TransferLearningHelper)
+from .optimize.listeners import (CheckpointListener,
+                                 CollectScoresIterationListener,
+                                 ComposableIterationListener,
+                                 EvaluativeListener, IterationListener,
+                                 PerformanceListener, ScoreIterationListener)
+from .utils.model_serializer import restore_model, save_model
 
 __version__ = "0.1.0"

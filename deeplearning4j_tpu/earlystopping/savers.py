@@ -1,0 +1,68 @@
+"""Best-model savers (reference earlystopping/saver/*.java)."""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+class EarlyStoppingModelSaver:
+    def save_best_model(self, model, score: float) -> None:
+        raise NotImplementedError
+
+    def save_latest_model(self, model, score: float) -> None:
+        pass
+
+    def get_best_model(self):
+        raise NotImplementedError
+
+
+class InMemoryModelSaver(EarlyStoppingModelSaver):
+    """Keep the best model's arrays in memory (reference
+    InMemoryModelSaver)."""
+
+    def __init__(self):
+        self._best = None
+
+    def save_best_model(self, model, score):
+        self._best = (model, model.params_tree, model.state_tree,
+                      model.opt_state)
+
+    def get_best_model(self):
+        """Returns a NEW network with the best-epoch arrays; the live
+        training model is left untouched (reference InMemoryModelSaver
+        stores a clone)."""
+        if self._best is None:
+            return None
+        model, params, state, opt = self._best
+        best = type(model)(model.conf.clone()).init(dtype=model._dtype)
+        best.params_tree = params
+        best.state_tree = state
+        best.opt_state = opt
+        best.iteration = model.iteration
+        best.epoch = model.epoch
+        return best
+
+
+class LocalFileModelSaver(EarlyStoppingModelSaver):
+    """Checkpoint best/latest to disk (reference LocalFile{Model,Graph}Saver
+    — one saver handles both model classes here)."""
+
+    def __init__(self, directory: str):
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+        self.best_path = os.path.join(directory, "bestModel.zip")
+        self.latest_path = os.path.join(directory, "latestModel.zip")
+
+    def save_best_model(self, model, score):
+        from ..utils.model_serializer import save_model
+        save_model(model, self.best_path)
+
+    def save_latest_model(self, model, score):
+        from ..utils.model_serializer import save_model
+        save_model(model, self.latest_path)
+
+    def get_best_model(self):
+        from ..utils.model_serializer import restore_model
+        if not os.path.exists(self.best_path):
+            return None
+        return restore_model(self.best_path)

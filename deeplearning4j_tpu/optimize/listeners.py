@@ -1,0 +1,174 @@
+"""Training listeners.
+
+Reference parity: optimize/api/{IterationListener,TrainingListener}.java SPI
+and impls in optimize/listeners/: ScoreIterationListener,
+PerformanceListener (samples/sec, batches/sec, ETL time),
+CollectScoresIterationListener, EvaluativeListener,
+ComposableIterationListener, plus CheckpointListener-style periodic saving.
+
+The contract: networks call `iteration_done(model, iteration)` after every
+optimizer step and `on_epoch_end(model, epoch)` per epoch — same hook points
+as the reference's Solver loop (StochasticGradientDescent.java:80).
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, List, Optional, Tuple
+
+log = logging.getLogger("deeplearning4j_tpu.listeners")
+
+
+class IterationListener:
+    """Base SPI (reference optimize/api/IterationListener.java)."""
+
+    def iteration_done(self, model, iteration: int) -> None:
+        pass
+
+    def on_epoch_end(self, model, epoch: int) -> None:
+        pass
+
+
+class ScoreIterationListener(IterationListener):
+    """Log score every N iterations (reference ScoreIterationListener)."""
+
+    def __init__(self, print_iterations: int = 10, printer=None):
+        self.n = max(1, int(print_iterations))
+        self._printer = printer or (lambda msg: log.info("%s", msg))
+
+    def iteration_done(self, model, iteration):
+        if iteration % self.n == 0:
+            self._printer(
+                f"Score at iteration {iteration} is "
+                f"{float(model.score_value):.6f}")
+
+
+class PerformanceListener(IterationListener):
+    """Throughput reporting (reference PerformanceListener: samples/sec,
+    batches/sec, iteration wall time). NB: fetches the score each report,
+    which fences the async dispatch queue — frequency matters on TPU."""
+
+    def __init__(self, frequency: int = 10, report_samples: bool = True,
+                 printer=None):
+        self.frequency = max(1, int(frequency))
+        self.report_samples = report_samples
+        self._printer = printer or (lambda msg: log.info("%s", msg))
+        self._last_time: Optional[float] = None
+        self._last_iter: Optional[int] = None
+        self._last_batch_size: Optional[int] = None
+
+    def set_batch_size(self, n: int):
+        self._last_batch_size = int(n)
+
+    def iteration_done(self, model, iteration):
+        if iteration % self.frequency != 0:
+            return
+        float(model.score_value)  # fence: measure real device time
+        now = time.perf_counter()
+        if self._last_time is not None and iteration > self._last_iter:
+            dt = now - self._last_time
+            iters = iteration - self._last_iter
+            msg = (f"iteration {iteration}: {iters / dt:.2f} batches/sec, "
+                   f"{dt / iters * 1000:.1f} ms/iter")
+            if self.report_samples and self._last_batch_size:
+                msg += f", {iters * self._last_batch_size / dt:.1f} samples/sec"
+            self._printer(msg)
+        self._last_time = now
+        self._last_iter = iteration
+
+
+class CollectScoresIterationListener(IterationListener):
+    """Accumulate (iteration, score) pairs (reference
+    CollectScoresIterationListener)."""
+
+    def __init__(self, frequency: int = 1):
+        self.frequency = max(1, int(frequency))
+        self.scores: List[Tuple[int, float]] = []
+
+    def iteration_done(self, model, iteration):
+        if iteration % self.frequency == 0:
+            self.scores.append((iteration, float(model.score_value)))
+
+
+class EvaluativeListener(IterationListener):
+    """Periodic evaluation against a held-out set (reference
+    EvaluativeListener; invocation per N iterations or per epoch)."""
+
+    def __init__(self, data, labels=None, frequency: int = 0,
+                 each_epoch: bool = True, callback=None):
+        self.data = data
+        self.labels = labels
+        self.frequency = int(frequency)
+        self.each_epoch = each_epoch
+        self.callback = callback
+        self.evaluations = []
+
+    def _evaluate(self, model):
+        ev = model.evaluate(self.data, self.labels)
+        self.evaluations.append(ev)
+        if self.callback is not None:
+            self.callback(model, ev)
+        else:
+            log.info("Evaluation: accuracy=%.4f f1=%.4f", ev.accuracy(),
+                     ev.f1())
+
+    def iteration_done(self, model, iteration):
+        if self.frequency > 0 and iteration % self.frequency == 0:
+            self._evaluate(model)
+
+    def on_epoch_end(self, model, epoch):
+        if self.each_epoch:
+            self._evaluate(model)
+
+
+class ComposableIterationListener(IterationListener):
+    """Fan-out to several listeners (reference
+    ComposableIterationListener)."""
+
+    def __init__(self, *listeners: IterationListener):
+        self.listeners = list(listeners)
+
+    def iteration_done(self, model, iteration):
+        for l in self.listeners:
+            l.iteration_done(model, iteration)
+
+    def on_epoch_end(self, model, epoch):
+        for l in self.listeners:
+            l.on_epoch_end(model, epoch)
+
+
+class CheckpointListener(IterationListener):
+    """Periodic checkpointing (reference CheckpointListener semantics:
+    every N iterations or every N epochs, keep last K)."""
+
+    def __init__(self, directory: str, every_n_iterations: int = 0,
+                 every_n_epochs: int = 0, keep_last: int = 3):
+        import os
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+        self.every_n_iterations = int(every_n_iterations)
+        self.every_n_epochs = int(every_n_epochs)
+        self.keep_last = int(keep_last)
+        self.saved: List[str] = []
+
+    def _save(self, model, tag: str):
+        import os
+        from ..utils.model_serializer import save_model
+        path = os.path.join(self.dir, f"checkpoint_{tag}.zip")
+        save_model(model, path)
+        self.saved.append(path)
+        while len(self.saved) > self.keep_last:
+            old = self.saved.pop(0)
+            try:
+                os.remove(old)
+            except OSError:
+                pass
+
+    def iteration_done(self, model, iteration):
+        if self.every_n_iterations > 0 and \
+                iteration % self.every_n_iterations == 0:
+            self._save(model, f"iter_{iteration}")
+
+    def on_epoch_end(self, model, epoch):
+        if self.every_n_epochs > 0 and epoch % self.every_n_epochs == 0:
+            self._save(model, f"epoch_{epoch}")

@@ -1,0 +1,243 @@
+"""ModelSerializer, listeners, early stopping, transfer learning tests
+(reference: ModelSerializer round-trip tests, TestEarlyStopping,
+TransferLearning tests in deeplearning4j-core)."""
+import math
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (Adam, DenseLayer, GravesLSTM, InputType,
+                                MultiLayerNetwork, NeuralNetConfiguration,
+                                OutputLayer, Sgd)
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.earlystopping import (
+    EarlyStoppingConfiguration, EarlyStoppingTrainer,
+    InvalidScoreIterationTerminationCondition, LocalFileModelSaver,
+    MaxEpochsTerminationCondition, MaxTimeIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition, TerminationReason)
+from deeplearning4j_tpu.nn.transfer_learning import (FineTuneConfiguration,
+                                                     TransferLearning,
+                                                     TransferLearningHelper)
+from deeplearning4j_tpu.optimize.listeners import (
+    CheckpointListener, CollectScoresIterationListener, PerformanceListener,
+    ScoreIterationListener)
+from deeplearning4j_tpu.utils.model_serializer import (restore_model,
+                                                       save_model)
+
+
+def _net(seed=7, n_in=6, classes=3, updater=None):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(updater or Adam(0.01)).list()
+            .layer(DenseLayer(n_out=12, activation="tanh"))
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=classes, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(n_in)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=48, n_in=6, classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, n_in)).astype(np.float32)
+    y = np.eye(classes, dtype=np.float32)[rng.integers(0, classes, n)]
+    return DataSet(x, y)
+
+
+class TestModelSerializer:
+    def test_mln_roundtrip(self, tmp_path):
+        net = _net()
+        ds = _data()
+        net.fit(ds, epochs=3, batch_size=16)
+        p = str(tmp_path / "model.zip")
+        save_model(net, p)
+        back = restore_model(p)
+        np.testing.assert_allclose(net.output(ds.features),
+                                   back.output(ds.features), rtol=1e-6)
+        assert back.iteration == net.iteration
+        # training continues identically (updater state restored)
+        net.fit(ds, epochs=1, batch_size=16)
+        back.fit(ds, epochs=1, batch_size=16)
+        np.testing.assert_allclose(net.params(), back.params(), rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_bf16_roundtrip(self, tmp_path):
+        """bf16 leaves survive npz round-trip (stored as raw bits + dtype
+        sidecar; np.load alone cannot represent bfloat16)."""
+        net = MultiLayerNetwork(_net().conf.clone()).init(dtype=jnp.bfloat16)
+        p = str(tmp_path / "bf16.zip")
+        save_model(net, p)
+        back = restore_model(p)
+        assert back.params_tree[0]["W"].dtype == jnp.bfloat16
+        x = np.random.default_rng(0).standard_normal((4, 6)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(net.output(x), np.float32),
+            np.asarray(back.output(x), np.float32))
+
+    def test_graph_roundtrip(self, tmp_path):
+        from deeplearning4j_tpu import ComputationGraph
+        conf = (NeuralNetConfiguration.builder().seed(3).updater(Sgd(0.1))
+                .graph_builder().add_inputs("in")
+                .add_layer("d", DenseLayer(n_out=8, activation="relu"), "in")
+                .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                              loss="mcxent"), "d")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(6)).build())
+        g = ComputationGraph(conf).init()
+        x = np.random.default_rng(0).standard_normal((4, 6)).astype(np.float32)
+        p = str(tmp_path / "graph.zip")
+        save_model(g, p)
+        back = restore_model(p)
+        np.testing.assert_allclose(g.output(x), back.output(x), rtol=1e-6)
+
+    def test_shape_mismatch_rejected(self, tmp_path):
+        net = _net()
+        p = str(tmp_path / "model.zip")
+        save_model(net, p)
+        # tamper: restore into a different-architecture config is impossible
+        # through the public API (config travels with the zip); simulate a
+        # corrupted params entry instead
+        import zipfile
+        import io
+        with zipfile.ZipFile(p) as zf:
+            names = {n: zf.read(n) for n in zf.namelist()}
+        names["coefficients.npz"] = names["state.npz"]
+        p2 = str(tmp_path / "bad.zip")
+        with zipfile.ZipFile(p2, "w") as zf:
+            for n, data in names.items():
+                zf.writestr(n, data)
+        with pytest.raises(ValueError):
+            restore_model(p2)
+
+
+class TestListeners:
+    def test_score_and_collect(self):
+        net = _net()
+        msgs = []
+        collect = CollectScoresIterationListener()
+        net.set_listeners(ScoreIterationListener(1, printer=msgs.append),
+                          collect)
+        net.fit(_data(), epochs=2, batch_size=16)
+        assert len(msgs) == 6
+        assert len(collect.scores) == 6
+        assert collect.scores[0][0] == 1
+
+    def test_performance_listener(self):
+        net = _net()
+        msgs = []
+        pl = PerformanceListener(frequency=2, printer=msgs.append)
+        pl.set_batch_size(16)
+        net.set_listeners(pl)
+        net.fit(_data(), epochs=2, batch_size=16)
+        assert any("batches/sec" in m for m in msgs)
+
+    def test_checkpoint_listener(self, tmp_path):
+        net = _net()
+        cl = CheckpointListener(str(tmp_path), every_n_iterations=2,
+                                keep_last=2)
+        net.set_listeners(cl)
+        net.fit(_data(), epochs=2, batch_size=16)
+        files = sorted(os.listdir(tmp_path))
+        assert len(files) == 2  # keep_last pruned older ones
+        restored = restore_model(os.path.join(tmp_path, files[-1]))
+        assert restored.num_params() == net.num_params()
+
+
+class TestEarlyStopping:
+    def test_score_improvement_stops(self):
+        net = _net(updater=Sgd(0.0))  # lr 0: score never improves
+        ds = _data()
+        conf = (EarlyStoppingConfiguration.builder()
+                .epoch_termination_conditions(
+                    ScoreImprovementEpochTerminationCondition(2),
+                    MaxEpochsTerminationCondition(50))
+                .score_calculator(lambda m: m.score(ds))
+                .build())
+        result = EarlyStoppingTrainer(conf, net, ds, batch_size=16).fit()
+        assert result.termination_reason == TerminationReason.EPOCH_TERMINATION
+        assert "ScoreImprovement" in result.termination_details
+        assert result.total_epochs <= 5
+
+    def test_max_epochs_and_best_model(self, tmp_path):
+        net = _net()
+        ds = _data()
+        saver = LocalFileModelSaver(str(tmp_path))
+        conf = (EarlyStoppingConfiguration.builder()
+                .model_saver(saver)
+                .epoch_termination_conditions(
+                    MaxEpochsTerminationCondition(4))
+                .score_calculator(lambda m: m.score(ds))
+                .build())
+        result = EarlyStoppingTrainer(conf, net, ds, batch_size=16).fit()
+        assert result.total_epochs == 4
+        assert result.best_model is not None
+        assert os.path.exists(os.path.join(str(tmp_path), "bestModel.zip"))
+        assert result.best_model_score <= max(result.score_vs_epoch.values())
+
+    def test_invalid_score_terminates(self):
+        net = _net(updater=Sgd(1e9))  # diverges to nan quickly
+        ds = _data()
+        conf = (EarlyStoppingConfiguration.builder()
+                .iteration_termination_conditions(
+                    InvalidScoreIterationTerminationCondition())
+                .epoch_termination_conditions(
+                    MaxEpochsTerminationCondition(50))
+                .build())
+        result = EarlyStoppingTrainer(conf, net, ds, batch_size=16).fit()
+        if result.termination_reason == TerminationReason.ITERATION_TERMINATION:
+            assert "InvalidScore" in result.termination_details
+
+
+class TestTransferLearning:
+    def test_freeze_and_replace_head(self):
+        net = _net()
+        ds = _data()
+        net.fit(ds, epochs=2, batch_size=16)
+        frozen_w = np.asarray(net.params_tree[0]["W"])
+
+        new_net = (TransferLearning.builder(net)
+                   .fine_tune_configuration(FineTuneConfiguration(
+                       updater=Adam(0.005)))
+                   .set_feature_extractor(1)       # freeze layers 0-1
+                   .remove_output_layer()
+                   .add_layer(OutputLayer(n_out=5, n_in=8,
+                                          activation="softmax",
+                                          loss="mcxent"))
+                   .build())
+        assert new_net.layers[0].frozen and new_net.layers[1].frozen
+        assert not new_net.layers[2].frozen
+        assert new_net.layers[2].n_out == 5
+        # old weights carried over
+        np.testing.assert_allclose(np.asarray(new_net.params_tree[0]["W"]),
+                                   frozen_w)
+        # train on 5-class data; frozen params must not move
+        rng = np.random.default_rng(1)
+        y5 = np.eye(5, dtype=np.float32)[rng.integers(0, 5, 48)]
+        new_net.fit(DataSet(ds.features, y5), epochs=3, batch_size=16)
+        np.testing.assert_allclose(np.asarray(new_net.params_tree[0]["W"]),
+                                   frozen_w)
+        assert new_net.output(ds.features).shape == (48, 5)
+
+    def test_n_out_replace(self):
+        net = _net()
+        new_net = (TransferLearning.builder(net)
+                   .n_out_replace(1, 20)
+                   .build())
+        assert new_net.layers[1].n_out == 20
+        assert new_net.layers[2].n_in == 20
+        assert new_net.output(_data().features).shape == (48, 3)
+
+    def test_helper_featurize(self):
+        net = _net()
+        ds = _data()
+        helper = TransferLearningHelper(net, frozen_until=1)
+        feat = helper.featurize(ds)
+        assert feat.features.shape == (48, 8)
+        before = net.output(ds.features)
+        helper.fit_featurized(feat, epochs=2, batch_size=16)
+        after = net.output(ds.features)
+        assert not np.allclose(before, after)
+        # frozen front unchanged => featurization stable
+        feat2 = helper.featurize(ds)
+        np.testing.assert_allclose(feat.features, feat2.features, rtol=1e-6)
