@@ -1,0 +1,279 @@
+"""Batched embedding training engine — the TPU-native SequenceVectors core.
+
+Reference parity: models/sequencevectors/SequenceVectors.java:187-310 (the
+generic trainer), models/embeddings/learning/impl/elements/{SkipGram.java
+:176-283, CBOW.java} (hierarchical softmax + negative sampling math executed
+natively via AggregateSkipGram/AggregateCBOW batches), and
+models/embeddings/inmemory/InMemoryLookupTable (syn0/syn1/syn1Neg/expTable/
+negative-sampling table).
+
+DOCUMENTED DIVERGENCE (SURVEY.md §7.9): the reference trains Hogwild-style —
+lock-free threads racing on shared syn0 (SequenceVectors.java:1101). That
+design does not map to TPU. Here training pairs are generated host-side and
+the updates run as LARGE BATCHED device steps: gather the embedding rows,
+compute the NS/HS objective, autodiff (the gradient of gather is
+scatter-add), SGD-update in one jitted program. Same objective, different
+(deterministic, batch-synchronous) update schedule — standard practice for
+accelerator word2vec; results match within the usual word2vec variance.
+
+Both objectives are supported, like the reference:
+  * negative sampling (negative > 0): log sigma(u_c.v_w) + sum_k log
+    sigma(-u_nk.v_w), negatives from the counts^0.75 unigram table
+  * hierarchical softmax: sum over huffman code bits of
+    log sigma((1-2b) u_point.v_w)
+"""
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .vocab import VocabCache, unigram_table
+
+Array = jax.Array
+
+
+# ---------------------------------------------------------------------------
+# Device-side jitted steps
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("cbow",))
+def _ns_step(tables, centers, contexts, negatives, lr, cbow: bool = False):
+    """One negative-sampling SGD step.
+
+    tables = {"syn0": [V, D], "syn1neg": [V, D]}
+    centers [B] int32; contexts [B] (skip-gram) or [B, W] + implicit mask
+    via index -1 (cbow); negatives [B, K] int32."""
+
+    def loss_fn(t):
+        syn0, syn1neg = t["syn0"], t["syn1neg"]
+        if cbow:
+            mask = (contexts >= 0).astype(syn0.dtype)  # [B, W]
+            ctx = jnp.take(syn0, jnp.maximum(contexts, 0), axis=0)  # [B,W,D]
+            denom = jnp.clip(mask.sum(-1, keepdims=True), 1.0)
+            h = (ctx * mask[..., None]).sum(1) / denom  # [B, D]
+            tgt = centers
+        else:
+            h = jnp.take(syn0, centers, axis=0)  # [B, D]
+            tgt = contexts
+        pos = jnp.take(syn1neg, tgt, axis=0)        # [B, D]
+        neg = jnp.take(syn1neg, negatives, axis=0)  # [B, K, D]
+        pos_score = jnp.sum(h * pos, axis=-1)
+        neg_score = jnp.einsum("bd,bkd->bk", h, neg)
+        loss = -(jax.nn.log_sigmoid(pos_score).sum()
+                 + jax.nn.log_sigmoid(-neg_score).sum())
+        return loss / centers.shape[0]
+
+    loss, grads = jax.value_and_grad(loss_fn)(tables)
+    new = {k: tables[k] - lr * grads[k] for k in tables}
+    return new, loss
+
+
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("cbow",))
+def _hs_step(tables, centers, contexts, codes, points, lr, cbow: bool = False):
+    """One hierarchical-softmax SGD step. codes/points [B, L]; code -1 pads."""
+
+    def loss_fn(t):
+        syn0, syn1 = t["syn0"], t["syn1"]
+        if cbow:
+            mask = (contexts >= 0).astype(syn0.dtype)
+            ctx = jnp.take(syn0, jnp.maximum(contexts, 0), axis=0)
+            denom = jnp.clip(mask.sum(-1, keepdims=True), 1.0)
+            h = (ctx * mask[..., None]).sum(1) / denom
+        else:
+            h = jnp.take(syn0, centers, axis=0)  # predict target's code
+        cmask = (codes >= 0).astype(syn0.dtype)          # [B, L]
+        pts = jnp.take(syn1, jnp.maximum(points, 0), axis=0)  # [B, L, D]
+        score = jnp.einsum("bd,bld->bl", h, pts)
+        sign = 1.0 - 2.0 * jnp.maximum(codes, 0).astype(syn0.dtype)
+        loss = -(jax.nn.log_sigmoid(sign * score) * cmask).sum()
+        return loss / centers.shape[0]
+
+    loss, grads = jax.value_and_grad(loss_fn)(tables)
+    new = {k: tables[k] - lr * grads[k] for k in tables}
+    return new, loss
+
+
+# ---------------------------------------------------------------------------
+# Host-side pair generation (the role of the reference's sentence->window
+# iteration in SkipGram.learnSequence / VectorCalculationsThread)
+# ---------------------------------------------------------------------------
+
+
+def sentences_to_indices(sentences, cache: VocabCache):
+    out = []
+    for tokens in sentences:
+        ids = [cache.index_of(t) for t in tokens]
+        ids = [i for i in ids if i >= 0]
+        if len(ids) > 1:
+            out.append(np.array(ids, dtype=np.int32))
+    return out
+
+
+def subsample(ids: np.ndarray, cache: VocabCache, threshold: float,
+              rng: np.random.Generator) -> np.ndarray:
+    """Frequent-word subsampling (reference sampling, word2vec formula)."""
+    if threshold <= 0:
+        return ids
+    total = max(1, cache.total_word_count)
+    freqs = np.array([cache.words[cache.word_for_index(i)].count / total
+                      for i in ids])
+    keep_prob = np.minimum(1.0, np.sqrt(threshold / freqs)
+                           + threshold / freqs)
+    return ids[rng.random(len(ids)) < keep_prob]
+
+
+def generate_pairs(indexed_sentences, window: int,
+                   rng: np.random.Generator,
+                   cache: Optional[VocabCache] = None,
+                   sampling: float = 0.0):
+    """(center, context) pairs with word2vec's random dynamic window."""
+    centers, contexts = [], []
+    for ids in indexed_sentences:
+        if sampling > 0 and cache is not None:
+            ids = subsample(ids, cache, sampling, rng)
+        n = len(ids)
+        if n < 2:
+            continue
+        b = rng.integers(1, window + 1, size=n)
+        for pos in range(n):
+            w = b[pos]
+            for off in range(-w, w + 1):
+                j = pos + off
+                if off != 0 and 0 <= j < n:
+                    centers.append(ids[pos])
+                    contexts.append(ids[j])
+    return (np.array(centers, dtype=np.int32),
+            np.array(contexts, dtype=np.int32))
+
+
+def generate_cbow(indexed_sentences, window: int, rng: np.random.Generator,
+                  cache=None, sampling: float = 0.0):
+    """(context-window [N, 2*window], center) with -1 padding."""
+    W = 2 * window
+    ctxs, centers = [], []
+    for ids in indexed_sentences:
+        if sampling > 0 and cache is not None:
+            ids = subsample(ids, cache, sampling, rng)
+        n = len(ids)
+        if n < 2:
+            continue
+        b = rng.integers(1, window + 1, size=n)
+        for pos in range(n):
+            w = b[pos]
+            row = [ids[pos + off] for off in range(-w, w + 1)
+                   if off != 0 and 0 <= pos + off < n]
+            if not row:
+                continue
+            row = row[:W] + [-1] * (W - len(row))
+            ctxs.append(row)
+            centers.append(ids[pos])
+    return (np.array(ctxs, dtype=np.int32).reshape(-1, W),
+            np.array(centers, dtype=np.int32))
+
+
+def codes_points_arrays(cache: VocabCache) -> Tuple[np.ndarray, np.ndarray]:
+    """Pad huffman codes/points to [V, L] with -1 (for HS batch lookup)."""
+    V = len(cache)
+    L = max((len(cache.words[w].code) for w in cache.index2word), default=1)
+    codes = np.full((V, L), -1, dtype=np.int32)
+    points = np.full((V, L), -1, dtype=np.int32)
+    for i, w in enumerate(cache.index2word):
+        vw = cache.words[w]
+        codes[i, :len(vw.code)] = vw.code
+        points[i, :len(vw.points)] = vw.points
+    return codes, points
+
+
+class BatchedEmbeddingTrainer:
+    """Run epochs of batched NS/HS updates over generated pairs."""
+
+    def __init__(self, cache: VocabCache, layer_size: int = 100,
+                 window: int = 5, negative: int = 5,
+                 use_hierarchic_softmax: bool = False, cbow: bool = False,
+                 learning_rate: float = 0.025,
+                 min_learning_rate: float = 1e-4,
+                 batch_size: int = 8192, sampling: float = 0.0,
+                 seed: int = 42, dtype=jnp.float32):
+        self.cache = cache
+        self.layer_size = int(layer_size)
+        self.window = int(window)
+        self.negative = int(negative)
+        self.use_hs = bool(use_hierarchic_softmax) or self.negative <= 0
+        self.cbow = bool(cbow)
+        self.lr = float(learning_rate)
+        self.min_lr = float(min_learning_rate)
+        self.batch_size = int(batch_size)
+        self.sampling = float(sampling)
+        self.seed = int(seed)
+        V, D = len(cache), self.layer_size
+        key = jax.random.PRNGKey(seed)
+        # syn0 init U(-0.5/D, 0.5/D) (reference resetWeights); syn1* zero.
+        self.tables = {"syn0": jax.random.uniform(
+            key, (V, D), dtype, -0.5 / D, 0.5 / D)}
+        if self.use_hs:
+            self.tables["syn1"] = jnp.zeros((max(V - 1, 1), D), dtype)
+            self._codes, self._points = codes_points_arrays(cache)
+        if self.negative > 0:
+            self.tables["syn1neg"] = jnp.zeros((V, D), dtype)
+            self._unigram = unigram_table(cache)
+        self.last_loss = None
+
+    def fit_sentences(self, indexed_sentences, epochs: int = 1):
+        rng = np.random.default_rng(self.seed)
+        total_steps = None
+        step = 0
+        for _ in range(epochs):
+            if self.cbow:
+                ctxs, centers = generate_cbow(
+                    indexed_sentences, self.window, rng, self.cache,
+                    self.sampling)
+                order = rng.permutation(len(centers))
+                ctxs, centers = ctxs[order], centers[order]
+                tgt = centers
+                n = len(centers)
+            else:
+                centers, contexts = generate_pairs(
+                    indexed_sentences, self.window, rng, self.cache,
+                    self.sampling)
+                order = rng.permutation(len(centers))
+                centers, contexts = centers[order], contexts[order]
+                tgt = contexts
+                n = len(centers)
+            if n == 0:
+                continue
+            if total_steps is None:
+                total_steps = max(1, epochs * (n // self.batch_size + 1))
+            for start in range(0, n, self.batch_size):
+                end = min(start + self.batch_size, n)
+                lr = max(self.min_lr,
+                         self.lr * (1.0 - step / max(1, total_steps)))
+                c = jnp.asarray(centers[start:end])
+                if self.cbow:
+                    ctx = jnp.asarray(ctxs[start:end])
+                else:
+                    ctx = jnp.asarray(contexts[start:end])
+                if self.negative > 0:
+                    negs = rng.choice(self._unigram,
+                                      size=(end - start, self.negative))
+                    self.tables, loss = _ns_step(
+                        self.tables, c, ctx, jnp.asarray(negs, jnp.int32),
+                        jnp.asarray(lr, jnp.float32), cbow=self.cbow)
+                else:
+                    t = np.asarray(tgt[start:end])
+                    self.tables, loss = _hs_step(
+                        self.tables, c, ctx,
+                        jnp.asarray(self._codes[t]),
+                        jnp.asarray(self._points[t]),
+                        jnp.asarray(lr, jnp.float32), cbow=self.cbow)
+                step += 1
+            self.last_loss = float(loss)
+        return self
+
+    def vectors(self) -> np.ndarray:
+        return np.asarray(self.tables["syn0"])

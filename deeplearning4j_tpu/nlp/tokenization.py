@@ -1,0 +1,101 @@
+"""Tokenization pipeline.
+
+Reference parity: deeplearning4j-nlp text/tokenization/ —
+TokenizerFactory SPI (DefaultTokenizerFactory, NGramTokenizerFactory),
+Tokenizer with TokenPreProcess (CommonPreprocessor: lowercase + strip
+punctuation, EndingPreProcessor), and text/stopwords/StopWords."""
+from __future__ import annotations
+
+import re
+from typing import Callable, List, Optional
+
+# Subset of the reference's stopwords list (text/stopwords; the reference
+# ships a file — a compact built-in default serves the same role).
+STOP_WORDS = frozenset("""a an and are as at be but by for if in into is it
+no not of on or such that the their then there these they this to was will
+with""".split())
+
+
+class TokenPreProcess:
+    def pre_process(self, token: str) -> str:
+        raise NotImplementedError
+
+
+class CommonPreprocessor(TokenPreProcess):
+    """Lowercase + strip punctuation/digits (reference
+    tokenizer/preprocessor/CommonPreprocessor)."""
+
+    _PUNCT = re.compile(r"[\d\.:,\"'\(\)\[\]|/?!;]+")
+
+    def pre_process(self, token):
+        return self._PUNCT.sub("", token.lower())
+
+
+class LowCasePreProcessor(TokenPreProcess):
+    def pre_process(self, token):
+        return token.lower()
+
+
+class EndingPreProcessor(TokenPreProcess):
+    """Crude stemmer (reference EndingPreProcessor: strips s/ed/ing/ly)."""
+
+    def pre_process(self, token):
+        for suffix in ("ing", "ed", "ly", "s"):
+            if token.endswith(suffix) and len(token) > len(suffix) + 2:
+                return token[: -len(suffix)]
+        return token
+
+
+class Tokenizer:
+    def __init__(self, tokens: List[str],
+                 pre_processor: Optional[TokenPreProcess] = None):
+        self._tokens = tokens
+        self._pre = pre_processor
+
+    def get_tokens(self) -> List[str]:
+        if self._pre is None:
+            return list(self._tokens)
+        out = []
+        for t in self._tokens:
+            t = self._pre.pre_process(t)
+            if t:
+                out.append(t)
+        return out
+
+
+class TokenizerFactory:
+    def create(self, text: str) -> Tokenizer:
+        raise NotImplementedError
+
+    def set_token_pre_processor(self, pre: TokenPreProcess):
+        self._pre = pre
+        return self
+
+
+class DefaultTokenizerFactory(TokenizerFactory):
+    """Whitespace tokenizer (reference DefaultTokenizerFactory wraps a
+    StringTokenizer)."""
+
+    def __init__(self):
+        self._pre: Optional[TokenPreProcess] = None
+
+    def create(self, text: str) -> Tokenizer:
+        return Tokenizer(text.split(), self._pre)
+
+
+class NGramTokenizerFactory(TokenizerFactory):
+    """N-gram tokens over the base tokenizer (reference
+    NGramTokenizerFactory)."""
+
+    def __init__(self, base: TokenizerFactory, min_n: int, max_n: int):
+        self._base = base
+        self.min_n, self.max_n = int(min_n), int(max_n)
+        self._pre = None
+
+    def create(self, text):
+        toks = self._base.create(text).get_tokens()
+        out = []
+        for n in range(self.min_n, self.max_n + 1):
+            for i in range(len(toks) - n + 1):
+                out.append(" ".join(toks[i:i + n]))
+        return Tokenizer(out, self._pre)

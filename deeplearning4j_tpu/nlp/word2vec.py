@@ -1,0 +1,208 @@
+"""Word2Vec facade + WordVectors query API.
+
+Reference parity: models/word2vec/Word2Vec.java (606 LoC Builder facade over
+SequenceVectors), models/embeddings/wordvectors/WordVectors/WordVectorsImpl
+(getWordVector, similarity, wordsNearest), models/embeddings/reader/impl/
+BasicModelUtils (cosine nearest-neighbor search).
+"""
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .embeddings import BatchedEmbeddingTrainer, sentences_to_indices
+from .sentence_iterator import CollectionSentenceIterator, SentenceIterator
+from .tokenization import DefaultTokenizerFactory, TokenizerFactory
+from .vocab import VocabCache, VocabConstructor
+
+
+class WordVectors:
+    """Query API over a vocab + vector table (reference
+    wordvectors/WordVectors interface)."""
+
+    def __init__(self, cache: VocabCache, vectors: np.ndarray):
+        self.vocab = cache
+        self._vectors = np.asarray(vectors)
+        self._normed: Optional[np.ndarray] = None
+
+    # -- lookup ------------------------------------------------------------
+    def has_word(self, word: str) -> bool:
+        return self.vocab.contains(word)
+
+    def word_vector(self, word: str) -> Optional[np.ndarray]:
+        i = self.vocab.index_of(word)
+        return None if i < 0 else self._vectors[i]
+
+    def get_word_vector_matrix(self) -> np.ndarray:
+        return self._vectors
+
+    def _norms(self):
+        if self._normed is None:
+            n = np.linalg.norm(self._vectors, axis=1, keepdims=True)
+            self._normed = self._vectors / np.clip(n, 1e-12, None)
+        return self._normed
+
+    # -- similarity --------------------------------------------------------
+    def similarity(self, w1: str, w2: str) -> float:
+        a, b = self.word_vector(w1), self.word_vector(w2)
+        if a is None or b is None:
+            return float("nan")
+        denom = np.linalg.norm(a) * np.linalg.norm(b)
+        return float(a @ b / denom) if denom else 0.0
+
+    def words_nearest(self, word_or_vec, top_n: int = 10) -> List[str]:
+        """Cosine nearest neighbors (reference BasicModelUtils
+        .wordsNearest)."""
+        exclude = set()
+        if isinstance(word_or_vec, str):
+            v = self.word_vector(word_or_vec)
+            if v is None:
+                return []
+            exclude.add(word_or_vec)
+        else:
+            v = np.asarray(word_or_vec)
+        v = v / np.clip(np.linalg.norm(v), 1e-12, None)
+        sims = self._norms() @ v
+        order = np.argsort(-sims)
+        out = []
+        for i in order:
+            w = self.vocab.word_for_index(int(i))
+            if w not in exclude:
+                out.append(w)
+            if len(out) >= top_n:
+                break
+        return out
+
+    def words_nearest_sum(self, positive: Sequence[str],
+                          negative: Sequence[str] = (),
+                          top_n: int = 10) -> List[str]:
+        """king - man + woman style analogy queries (reference
+        wordsNearest(positive, negative, n))."""
+        v = np.zeros(self._vectors.shape[1])
+        for w in positive:
+            wv = self.word_vector(w)
+            if wv is not None:
+                v = v + wv
+        for w in negative:
+            wv = self.word_vector(w)
+            if wv is not None:
+                v = v - wv
+        sims_order = self.words_nearest(v, top_n + len(positive) +
+                                        len(negative))
+        skip = set(positive) | set(negative)
+        return [w for w in sims_order if w not in skip][:top_n]
+
+
+class Word2Vec(WordVectors):
+    """Builder-configured trainer (reference Word2Vec.Builder surface)."""
+
+    def __init__(self, **kw):
+        self._kw = kw
+        self._trainer: Optional[BatchedEmbeddingTrainer] = None
+        # WordVectors state filled by fit()
+        self.vocab = None
+        self._vectors = None
+        self._normed = None
+
+    @staticmethod
+    def builder() -> "Word2VecBuilder":
+        return Word2VecBuilder()
+
+    def fit(self) -> "Word2Vec":
+        kw = self._kw
+        it: SentenceIterator = kw["iterate"]
+        tf: TokenizerFactory = kw.get("tokenizer_factory",
+                                      DefaultTokenizerFactory())
+
+        def token_stream():
+            for sentence in it:
+                yield tf.create(sentence).get_tokens()
+
+        cache = VocabConstructor(
+            min_word_frequency=kw.get("min_word_frequency", 1)).build(
+                token_stream())
+        self.vocab = cache
+        trainer = BatchedEmbeddingTrainer(
+            cache,
+            layer_size=kw.get("layer_size", 100),
+            window=kw.get("window_size", 5),
+            negative=kw.get("negative", 5),
+            use_hierarchic_softmax=kw.get("use_hierarchic_softmax", False),
+            cbow=kw.get("elements_learning_algorithm", "skipgram") == "cbow",
+            learning_rate=kw.get("learning_rate", 0.025),
+            min_learning_rate=kw.get("min_learning_rate", 1e-4),
+            batch_size=kw.get("batch_size", 8192),
+            sampling=kw.get("sampling", 0.0),
+            seed=kw.get("seed", 42))
+        indexed = sentences_to_indices(
+            (tf.create(s).get_tokens() for s in it), cache)
+        trainer.fit_sentences(indexed, epochs=kw.get("epochs", 1)
+                              * kw.get("iterations", 1))
+        self._trainer = trainer
+        self._vectors = trainer.vectors()
+        self._normed = None
+        return self
+
+
+class Word2VecBuilder:
+    """Fluent builder mirroring reference Word2Vec.Builder names."""
+
+    def __init__(self):
+        self._kw = {}
+
+    def _set(self, k, v):
+        self._kw[k] = v
+        return self
+
+    def iterate(self, it):
+        if isinstance(it, (list, tuple)):
+            it = CollectionSentenceIterator(it)
+        return self._set("iterate", it)
+
+    def tokenizer_factory(self, tf):
+        return self._set("tokenizer_factory", tf)
+
+    def layer_size(self, n):
+        return self._set("layer_size", int(n))
+
+    def window_size(self, n):
+        return self._set("window_size", int(n))
+
+    def min_word_frequency(self, n):
+        return self._set("min_word_frequency", int(n))
+
+    def negative_sample(self, n):
+        return self._set("negative", int(n))
+
+    def use_hierarchic_softmax(self, b=True):
+        return self._set("use_hierarchic_softmax", bool(b))
+
+    def elements_learning_algorithm(self, name):
+        return self._set("elements_learning_algorithm", name.lower())
+
+    def learning_rate(self, lr):
+        return self._set("learning_rate", float(lr))
+
+    def min_learning_rate(self, lr):
+        return self._set("min_learning_rate", float(lr))
+
+    def epochs(self, n):
+        return self._set("epochs", int(n))
+
+    def iterations(self, n):
+        return self._set("iterations", int(n))
+
+    def batch_size(self, n):
+        return self._set("batch_size", int(n))
+
+    def sampling(self, s):
+        return self._set("sampling", float(s))
+
+    def seed(self, s):
+        return self._set("seed", int(s))
+
+    def build(self) -> Word2Vec:
+        if "iterate" not in self._kw:
+            raise ValueError("Word2Vec.builder(): call iterate(...) first")
+        return Word2Vec(**self._kw)
