@@ -40,6 +40,31 @@ Array = jax.Array
 # ---------------------------------------------------------------------------
 # Device-side jitted steps
 # ---------------------------------------------------------------------------
+#
+# Update rule (documented divergence from the sequential Hogwild schedule):
+# the loss is SUMMED over pairs and each table row's gradient is divided by
+# the number of pairs touching that row in the batch. A row touched once
+# takes exactly the reference's per-pair lr-scaled step; a row touched k
+# times takes the AVERAGE of its k per-pair steps. Applying the raw sum
+# (k simultaneous full steps) diverges whenever k is large — sequential SGD
+# re-evaluates the gradient after every step and self-corrects, a batch
+# cannot. Averaging under-trains *frequent* rows relative to the reference,
+# which is the population word2vec's own subsampling deliberately throttles;
+# rare-word dynamics (what embeddings quality hinges on) match. The HS path
+# additionally keeps word2vec.c's MAX_EXP=6 skip-window.
+
+_MAX_EXP = 6.0
+
+
+def _row_scale(grad: Array, indices: Array, valid=None) -> Array:
+    """grad [V, D] scaled per-row by 1/count(indices); `valid` masks padded
+    index slots (e.g. -1 context / code padding)."""
+    ones = jnp.ones(indices.shape, grad.dtype)
+    if valid is not None:
+        ones = ones * valid.astype(grad.dtype)
+    counts = jnp.zeros((grad.shape[0],), grad.dtype).at[
+        jnp.maximum(indices, 0).reshape(-1)].add(ones.reshape(-1))
+    return grad / jnp.clip(counts, 1.0)[:, None]
 
 
 @functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("cbow",))
@@ -65,13 +90,22 @@ def _ns_step(tables, centers, contexts, negatives, lr, cbow: bool = False):
         neg = jnp.take(syn1neg, negatives, axis=0)  # [B, K, D]
         pos_score = jnp.sum(h * pos, axis=-1)
         neg_score = jnp.einsum("bd,bkd->bk", h, neg)
-        loss = -(jax.nn.log_sigmoid(pos_score).sum()
+        # SUM over pairs, not mean: each pair contributes a full lr-scaled
+        # update exactly like the reference's per-pair Hogwild SGD — the
+        # batch just applies them simultaneously.
+        return -(jax.nn.log_sigmoid(pos_score).sum()
                  + jax.nn.log_sigmoid(-neg_score).sum())
-        return loss / centers.shape[0]
 
     loss, grads = jax.value_and_grad(loss_fn)(tables)
+    if cbow:
+        grads["syn0"] = _row_scale(grads["syn0"], contexts, contexts >= 0)
+        syn1_idx = jnp.concatenate([centers[:, None], negatives], axis=1)
+    else:
+        grads["syn0"] = _row_scale(grads["syn0"], centers)
+        syn1_idx = jnp.concatenate([contexts[:, None], negatives], axis=1)
+    grads["syn1neg"] = _row_scale(grads["syn1neg"], syn1_idx)
     new = {k: tables[k] - lr * grads[k] for k in tables}
-    return new, loss
+    return new, loss / centers.shape[0]
 
 
 @functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("cbow",))
@@ -90,13 +124,23 @@ def _hs_step(tables, centers, contexts, codes, points, lr, cbow: bool = False):
         cmask = (codes >= 0).astype(syn0.dtype)          # [B, L]
         pts = jnp.take(syn1, jnp.maximum(points, 0), axis=0)  # [B, L, D]
         score = jnp.einsum("bd,bld->bl", h, pts)
+        # word2vec.c skip-rule: a code bit whose score left the [-6, 6]
+        # window contributes no loss and no gradient (stop_gradient on the
+        # mask keeps the skip itself out of autodiff).
+        in_win = jax.lax.stop_gradient(
+            (jnp.abs(score) < _MAX_EXP).astype(syn0.dtype))
         sign = 1.0 - 2.0 * jnp.maximum(codes, 0).astype(syn0.dtype)
-        loss = -(jax.nn.log_sigmoid(sign * score) * cmask).sum()
-        return loss / centers.shape[0]
+        # SUM over pairs (see _ns_step): parity with per-pair SGD stepping.
+        return -(jax.nn.log_sigmoid(sign * score) * cmask * in_win).sum()
 
     loss, grads = jax.value_and_grad(loss_fn)(tables)
+    if cbow:
+        grads["syn0"] = _row_scale(grads["syn0"], contexts, contexts >= 0)
+    else:
+        grads["syn0"] = _row_scale(grads["syn0"], centers)
+    grads["syn1"] = _row_scale(grads["syn1"], points, codes >= 0)
     new = {k: tables[k] - lr * grads[k] for k in tables}
-    return new, loss
+    return new, loss / centers.shape[0]
 
 
 # ---------------------------------------------------------------------------
@@ -198,7 +242,7 @@ class BatchedEmbeddingTrainer:
                  use_hierarchic_softmax: bool = False, cbow: bool = False,
                  learning_rate: float = 0.025,
                  min_learning_rate: float = 1e-4,
-                 batch_size: int = 8192, sampling: float = 0.0,
+                 batch_size: int = 1024, sampling: float = 0.0,
                  seed: int = 42, dtype=jnp.float32):
         self.cache = cache
         self.layer_size = int(layer_size)
@@ -258,19 +302,26 @@ class BatchedEmbeddingTrainer:
                     ctx = jnp.asarray(ctxs[start:end])
                 else:
                     ctx = jnp.asarray(contexts[start:end])
-                if self.negative > 0:
-                    negs = rng.choice(self._unigram,
-                                      size=(end - start, self.negative))
-                    self.tables, loss = _ns_step(
-                        self.tables, c, ctx, jnp.asarray(negs, jnp.int32),
-                        jnp.asarray(lr, jnp.float32), cbow=self.cbow)
-                else:
+                # Reference SkipGram.java:176-283 runs HS rounds whenever
+                # huffman codes exist AND an NS round when negative>0 —
+                # both objectives can train in the same pass. `loss` sums
+                # whichever objectives ran so monitoring sees both.
+                loss = 0.0
+                if self.use_hs:
                     t = np.asarray(tgt[start:end])
-                    self.tables, loss = _hs_step(
+                    self.tables, hs_loss = _hs_step(
                         self.tables, c, ctx,
                         jnp.asarray(self._codes[t]),
                         jnp.asarray(self._points[t]),
                         jnp.asarray(lr, jnp.float32), cbow=self.cbow)
+                    loss = loss + hs_loss
+                if self.negative > 0:
+                    negs = rng.choice(self._unigram,
+                                      size=(end - start, self.negative))
+                    self.tables, ns_loss = _ns_step(
+                        self.tables, c, ctx, jnp.asarray(negs, jnp.int32),
+                        jnp.asarray(lr, jnp.float32), cbow=self.cbow)
+                    loss = loss + ns_loss
                 step += 1
             self.last_loss = float(loss)
         return self

@@ -115,28 +115,32 @@ class Word2Vec(WordVectors):
         tf: TokenizerFactory = kw.get("tokenizer_factory",
                                       DefaultTokenizerFactory())
 
-        def token_stream():
-            for sentence in it:
-                yield tf.create(sentence).get_tokens()
+        # Materialise the tokenised corpus ONCE: a generator-backed
+        # SentenceIterator would silently yield nothing on a second pass
+        # (vocab scan + training scan), so we tokenise a single time and
+        # reuse the list for both (reference resets its iterator between
+        # the VocabConstructor scan and training, SequenceVectors.java:187).
+        tokenized = [tf.create(sentence).get_tokens() for sentence in it]
 
         cache = VocabConstructor(
             min_word_frequency=kw.get("min_word_frequency", 1)).build(
-                token_stream())
+                tokenized)
         self.vocab = cache
+        # Reference defaults: useHierarchicSoftmax=true, negative=0
+        # (Word2Vec.java builder defaults).
         trainer = BatchedEmbeddingTrainer(
             cache,
             layer_size=kw.get("layer_size", 100),
             window=kw.get("window_size", 5),
-            negative=kw.get("negative", 5),
-            use_hierarchic_softmax=kw.get("use_hierarchic_softmax", False),
+            negative=kw.get("negative", 0),
+            use_hierarchic_softmax=kw.get("use_hierarchic_softmax", True),
             cbow=kw.get("elements_learning_algorithm", "skipgram") == "cbow",
             learning_rate=kw.get("learning_rate", 0.025),
             min_learning_rate=kw.get("min_learning_rate", 1e-4),
-            batch_size=kw.get("batch_size", 8192),
+            batch_size=kw.get("batch_size", 1024),
             sampling=kw.get("sampling", 0.0),
             seed=kw.get("seed", 42))
-        indexed = sentences_to_indices(
-            (tf.create(s).get_tokens() for s in it), cache)
+        indexed = sentences_to_indices(tokenized, cache)
         trainer.fit_sentences(indexed, epochs=kw.get("epochs", 1)
                               * kw.get("iterations", 1))
         self._trainer = trainer

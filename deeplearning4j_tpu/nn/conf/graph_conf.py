@@ -128,6 +128,11 @@ class GraphBuilder:
         if len(in_names) > 1:
             # Implicit merge, like the reference's "-merge" vertex.
             merge_name = f"{name}-merge"
+            if merge_name in self._nodes or merge_name in self._inputs:
+                raise ValueError(
+                    f"Implicit merge vertex name {merge_name!r} collides "
+                    f"with an existing node; rename that node or merge "
+                    f"explicitly via add_vertex")
             self._nodes[merge_name] = GraphNode(inputs=in_names,
                                                 vertex=MergeVertex())
             in_names = [merge_name]

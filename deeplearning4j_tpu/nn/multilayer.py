@@ -22,6 +22,7 @@ TPU-native redesign:
 """
 from __future__ import annotations
 
+import logging
 import math
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
@@ -38,6 +39,8 @@ from .layers import core as core_layers
 from .updaters import normalize_layer_gradients
 
 Array = jax.Array
+
+log = logging.getLogger(__name__)
 
 
 def _regularization_score(layers, params) -> Array:
@@ -216,8 +219,17 @@ class MultiLayerNetwork:
         do_step = do_step or self._do_step
         if self.conf.backprop_type == BackpropType.TRUNCATED_BPTT and \
                 ds.features.ndim == 3:
-            self._fit_tbptt(ds, do_step)
-            return
+            if ds.labels.ndim == 3:
+                self._fit_tbptt(ds, do_step)
+                return
+            # Reference doTruncatedBPTT requires rank-3 labels and falls
+            # back with a warning; slicing 2-D labels on axis 1 would window
+            # the class axis instead of time.
+            if not getattr(self, "_warned_tbptt_labels", False):
+                log.warning(
+                    "Truncated BPTT requires rank-3 (time-series) labels; "
+                    "got rank-%d — using standard BPTT", ds.labels.ndim)
+                self._warned_tbptt_labels = True
         self._rnn_carry = None  # standard BPTT: every batch starts fresh
         do_step(ds.features, ds.labels, ds.features_mask, ds.labels_mask)
 

@@ -83,15 +83,46 @@ class ParallelWrapper:
         a = np.asarray(a)
         if cast_dtype is not None and a.dtype.kind == "f":
             a = a.astype(cast_dtype)
-        padded, n = mesh_lib.pad_batch_to_multiple(a, self.data_shards)
-        if padded.shape[0] != n and not self._warned_pad:
-            log.warning(
-                "Batch size %d not divisible by %d data shards; padding by "
-                "repeating the tail example (gradients include the pad — use "
-                "divisible batch sizes for exact single-device equivalence)",
-                n, self.data_shards)
-            self._warned_pad = True
+        padded, _ = mesh_lib.pad_batch_to_multiple(a, self.data_shards)
         return jax.device_put(padded, mesh_lib.batch_sharded(self.mesh))
+
+    def _pad_lmask(self, lmask, n: int):
+        """Zero-weight labels mask covering `pad` appended rows, constructed
+        so the LOSS (numerator and normalization) exactly matches
+        single-device training on the original batch:
+          * no user mask  -> ones (n,1) + zero pad rows; the rank-2 mask
+            path divides by sum(mask) = n, the unpadded mean.
+          * rank-1 user mask (per-example weights) -> zero-padded and
+            scaled by padded_n/n; the rank-1 mean path then yields
+            sum(sa*m)/n, the unpadded value (exact by linearity).
+          * rank>=2 user mask -> zero pad rows; sum(mask) is unchanged.
+        Caveat (hence the warning): pad rows still traverse the FORWARD
+        pass, so batch-statistics state (BatchNormalization train-mode
+        mean/var and committed running stats) and shape-dependent dropout
+        draws include them — use divisible batch sizes for bit-exact
+        equivalence on BN/dropout models."""
+        pad = (-n) % self.data_shards
+        if pad == 0:
+            return lmask
+        if not self._warned_pad:
+            log.warning(
+                "Batch size %d not divisible by %d data shards; padding with "
+                "zero-loss-weight copies of the tail example. Loss/gradients "
+                "match single-device exactly, but BatchNorm batch statistics "
+                "and dropout draws include the pad rows — use divisible "
+                "batch sizes for bit-exact equivalence", n, self.data_shards)
+            self._warned_pad = True
+        if lmask is None:
+            m = np.ones((n, 1), np.float32)
+        else:
+            m = np.asarray(lmask, np.float32)
+        zeros = np.zeros((pad,) + m.shape[1:], m.dtype)
+        out = np.concatenate([m, zeros], axis=0)
+        if out.ndim == 1:
+            # Rank-1 masks take the mean-over-batch loss path; rescale so
+            # mean over padded_n equals the unpadded mean over n.
+            out = out * (out.shape[0] / float(n))
+        return out
 
     # -------------------------------------------------------------------- fit
     def fit(self, data, labels=None, *, epochs: int = 1,
@@ -119,6 +150,11 @@ class ParallelWrapper:
             self._place_model()
         if hasattr(net, "_pack"):  # ComputationGraph
             inputs, labels, fm, lm = net._pack(net._coerce(ds))
+            n = next(iter(inputs.values())).shape[0]
+            if n % self.data_shards != 0:
+                # Every output head gets a zero-weight mask over pad rows.
+                lm = {name: self._pad_lmask(lm.get(name), n)
+                      for name in labels}
             shard = lambda d: {k: self._shard_arr(v) for k, v in d.items()}
             net._run_and_commit(shard(inputs), shard(labels), shard(fm),
                                 shard(lm), mesh=self.mesh)
@@ -130,6 +166,8 @@ class ParallelWrapper:
         over the mesh's data axis, then delegate invoke+commit to the net
         so the commit tail can never diverge from the single-device path."""
         net = self.model
+        if x.shape[0] % self.data_shards != 0:
+            lmask = self._pad_lmask(lmask, x.shape[0])
         net._run_and_commit(
             self._shard_arr(x, cast_dtype=net._dtype), self._shard_arr(y),
             self._shard_arr(fmask), self._shard_arr(lmask), mesh=self.mesh)

@@ -95,6 +95,23 @@ class TestParallelWrapper:
         assert net.iteration == 1
         assert np.isfinite(float(net.score_value))
 
+    def test_padding_uneven_batch_equals_single_device(self):
+        """Pad rows are zero-loss-weighted, so DP on a non-divisible batch
+        must match single-device training exactly (round-2 fix: pads used
+        to leak into gradients)."""
+        ds = _data(37)  # 37 % 8 != 0
+        single = MultiLayerNetwork(_mlp_conf()).init()
+        for _ in range(4):
+            single._fit_batch(ds)
+        dp = MultiLayerNetwork(_mlp_conf()).init()
+        pw = ParallelWrapper(dp, mesh=data_parallel_mesh(8))
+        for _ in range(4):
+            pw.fit_batch(ds)
+        for a, b in zip(jax.tree_util.tree_leaves(single.params_tree),
+                        jax.tree_util.tree_leaves(dp.params_tree)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
 
 class TestGraftEntry:
     def test_entry_compiles(self):
