@@ -12,9 +12,17 @@ N-replica thread zoo collapses into ONE jitted train step whose batch input
 is sharded over the mesh's "data" axis. XLA inserts the gradient allreduce
 (psum over ICI) exactly where the reference does a parameter average; params
 stay replicated, so there is no separate "propagate" step and no thread
-synchronization. averaging_frequency > 1 (local SGD, reference behavioral
-parity for infrequent averaging) is not implemented yet and is rejected
-loudly rather than silently ignored.
+synchronization.
+
+averaging_frequency > 1 (ParallelWrapper.java:417-424; Spark
+ParameterAveragingTrainingMaster splits so each worker runs
+`averagingFrequency` minibatches between syncs, :346-357) is local SGD:
+params/updater-state/layer-state get a leading replica axis sharded over
+"data", the per-replica step is the SAME jitted train step vmapped over
+that axis (so each device takes independent local steps with zero
+cross-device traffic), and every F steps a jitted mean-over-replicas +
+re-broadcast performs the parameter average (XLA lowers it to an
+allreduce over ICI — the averageAndPropagate analog).
 """
 from __future__ import annotations
 
@@ -47,15 +55,19 @@ class ParallelWrapper:
                 f"ParallelWrapper needs a mesh with a '{mesh_lib.DATA_AXIS}' "
                 f"axis; got axes {self.mesh.axis_names}")
         self.data_shards = int(self.mesh.shape[mesh_lib.DATA_AXIS])
-        if int(averaging_frequency) != 1:
-            raise NotImplementedError(
-                "averaging_frequency > 1 (local SGD) is not implemented yet; "
-                "synchronous DP (frequency 1) is the reference-equivalent "
-                "default per TestCompareParameterAveragingSparkVsSingleMachine")
-        self.averaging_frequency = 1
+        if int(averaging_frequency) < 1:
+            raise ValueError("averaging_frequency must be >= 1")
+        self.averaging_frequency = int(averaging_frequency)
         self.prefetch_buffer = prefetch_buffer
         self._warned_pad = False
         self._placed = False
+        # ---- local-SGD (averaging_frequency > 1) machinery ----
+        self._stacked = None          # (params, opt, state) with replica axis
+        self._stacked_rngs = None
+        self._synced_params_ref = None
+        self._since_avg = 0
+        self._stacked_step = None
+        self._jit_helpers = None
 
     # ------------------------------------------------------------------ build
     @staticmethod
@@ -137,29 +149,41 @@ class ParallelWrapper:
             self.model.fit(data, labels, epochs=epochs, batch_size=batch_size,
                            async_queue_size=self.prefetch_buffer,
                            step_fn=self.fit_batch)
+        self.finalize()
         return self
 
     def fit_batch(self, ds) -> None:
-        """One globally-synchronous DP step (tBPTT windowing included, via
-        the net's own dispatch with our sharded step substituted). Accepts a
-        DataSet for MultiLayerNetwork or a MultiDataSet/DataSet for
-        ComputationGraph."""
+        """One DP step. With averaging_frequency == 1 this is a globally-
+        synchronous sharded step (tBPTT windowing included, via the net's
+        own dispatch). With frequency > 1 it is one LOCAL step per replica
+        (see module docstring). Accepts a DataSet for MultiLayerNetwork or
+        a MultiDataSet/DataSet for ComputationGraph."""
         net = self.model
+        if self.averaging_frequency > 1:
+            self._local_round(ds)
+            return
         if not self._placed:
             net._check_init()
             self._place_model()
         if hasattr(net, "_pack"):  # ComputationGraph
-            inputs, labels, fm, lm = net._pack(net._coerce(ds))
-            n = next(iter(inputs.values())).shape[0]
-            if n % self.data_shards != 0:
-                # Every output head gets a zero-weight mask over pad rows.
-                lm = {name: self._pad_lmask(lm.get(name), n)
-                      for name in labels}
+            inputs, labels, fm, lm, _ = self._prep_graph_batch(ds)
             shard = lambda d: {k: self._shard_arr(v) for k, v in d.items()}
             net._run_and_commit(shard(inputs), shard(labels), shard(fm),
                                 shard(lm), mesh=self.mesh)
             return
         net._fit_batch(ds, do_step=self._sync_step)
+
+    def _prep_graph_batch(self, ds):
+        """Pack a (Multi)DataSet for the graph and zero-weight any pad rows
+        (shared by the sync and local-SGD paths so the padding rule can
+        never diverge between them)."""
+        net = self.model
+        inputs, labels, fm, lm = net._pack(net._coerce(ds))
+        n = next(iter(inputs.values())).shape[0]
+        if n % self.data_shards != 0:
+            # Every output head gets a zero-weight mask over pad rows.
+            lm = {name: self._pad_lmask(lm.get(name), n) for name in labels}
+        return inputs, labels, fm, lm, n
 
     def _sync_step(self, x, y, fmask, lmask) -> None:
         """Sharded analog of MultiLayerNetwork._do_step: shard the inputs
@@ -172,11 +196,172 @@ class ParallelWrapper:
             self._shard_arr(x, cast_dtype=net._dtype), self._shard_arr(y),
             self._shard_arr(fmask), self._shard_arr(lmask), mesh=self.mesh)
 
+    # ----------------------------------------------------- local SGD (freq>1)
+    def _build_local_machinery(self, n_data_args: int):
+        """Jitted helpers for the replica-stacked representation."""
+        from jax.sharding import NamedSharding, PartitionSpec
+        W = self.data_shards
+        stacked_sh = NamedSharding(self.mesh, PartitionSpec(mesh_lib.DATA_AXIS))
+        tmap = jax.tree_util.tree_map
+
+        # Per-replica local step: the net's own jitted step, vmapped over
+        # the replica axis. iteration is shared (in_axes None); params/opt/
+        # state/rng/data are per-replica (axis 0, sharded over "data"), so
+        # each device computes its replica with no collective ops.
+        in_axes = (0, 0, 0, None, 0) + (0,) * n_data_args
+        self._stacked_step = jax.jit(jax.vmap(
+            self.model._train_step_fn, in_axes=in_axes,
+            out_axes=(0, 0, 0, None, 0, 0)))
+
+        def stack(t):  # replicate net trees onto the replica axis
+            return tmap(lambda a: jnp.broadcast_to(a[None], (W,) + a.shape), t)
+
+        def avg(t):  # averageAndPropagate: mean over replicas, re-broadcast
+            def one(a):
+                m = jnp.mean(a, axis=0) if jnp.issubdtype(a.dtype, jnp.floating) \
+                    else a[0]
+                return jnp.broadcast_to(m[None], a.shape)
+            return tmap(one, t)
+
+        def take0(t):  # replicas are equal post-average; unstack view
+            return tmap(lambda a: a[0], t)
+
+        self._jit_helpers = {
+            "stack": jax.jit(stack, out_shardings=stacked_sh),
+            "avg": jax.jit(avg, out_shardings=stacked_sh),
+            "take0": jax.jit(take0),
+        }
+
+    def _ensure_stacked(self, n_data_args: int):
+        net = self.model
+        if self._stacked is not None:
+            # Restack if the net's params were swapped behind our back
+            # (checkpoint restore, direct net.fit, transfer surgery...):
+            # the cached replica stack would silently discard them.
+            if net.params_tree is self._synced_params_ref:
+                return
+            self._stacked = None
+        if self._stacked_step is None:
+            self._build_local_machinery(n_data_args)
+        h = self._jit_helpers
+        self._stacked = h["stack"]((net.params_tree, net.opt_state,
+                                    self._net_state_tree()))
+        self._synced_params_ref = net.params_tree
+        rngs = jax.random.split(net._rng, self.data_shards)
+        self._stacked_rngs = jax.device_put(
+            rngs, mesh_lib.batch_sharded(self.mesh))
+        self._since_avg = 0
+
+    def _net_state_tree(self):
+        net = self.model
+        return net._merged_state() if hasattr(net, "_merged_state") \
+            else net.state_tree
+
+    def _stack_data(self, a, n: int):
+        """Pad (repeating the tail row) + reshape (n,...) → (W, n/W, ...).
+        Device-resident arrays are padded/reshaped with jnp ops so they
+        never round-trip through host memory."""
+        if a is None:
+            return None
+        W = self.data_shards
+        if isinstance(a, jax.Array):
+            pad = (-a.shape[0]) % W
+            if pad:
+                a = jnp.concatenate(
+                    [a, jnp.broadcast_to(a[-1:], (pad,) + a.shape[1:])], 0)
+            stacked = a.reshape((W, -1) + a.shape[1:])
+        else:
+            a = np.asarray(a)
+            padded, _ = mesh_lib.pad_batch_to_multiple(a, W)
+            stacked = padded.reshape((W, -1) + padded.shape[1:])
+        return jax.device_put(stacked, mesh_lib.batch_sharded(self.mesh))
+
+    def _local_round(self, ds) -> None:
+        """One local step on every replica; average every F-th round.
+        Mapping to the reference: each replica plays one DefaultTrainer /
+        Spark worker, its shard of this batch is the worker's minibatch,
+        and F rounds between averages = averagingFrequency iterations
+        (ParallelWrapper.java:417-424)."""
+        net = self.model
+        net._check_init()
+        if hasattr(net, "_pack"):  # ComputationGraph
+            inputs, labels, fm, lm, n = self._prep_graph_batch(ds)
+            data = tuple({k: self._stack_data(v, n) for k, v in d.items()}
+                         for d in (inputs, labels, fm, lm))
+        else:
+            from ..nn.conf.builders import BackpropType
+            if net.conf.backprop_type == BackpropType.TRUNCATED_BPTT and \
+                    np.asarray(ds.features).ndim == 3:
+                raise NotImplementedError(
+                    "tBPTT with averaging_frequency > 1 is not supported; "
+                    "use averaging_frequency=1 (synchronous DP) for "
+                    "truncated-BPTT models")
+            x, y = ds.features, ds.labels
+            fmask, lmask = ds.features_mask, ds.labels_mask
+            n = np.asarray(x).shape[0]
+            if n % self.data_shards != 0:
+                lmask = self._pad_lmask(lmask, n)
+            x = np.asarray(x)
+            if x.dtype.kind == "f":
+                x = x.astype(np.dtype(net._dtype))
+            data = tuple(self._stack_data(a, n)
+                         for a in (x, y, fmask, lmask))
+        self._ensure_stacked(len(data))
+        params, opt, state = self._stacked
+        with self.mesh:
+            (params, opt, state, _, self._stacked_rngs,
+             losses) = self._stacked_step(
+                params, opt, state, jnp.asarray(net.iteration, jnp.int32),
+                self._stacked_rngs, *data)
+        self._stacked = (params, opt, state)
+        self._since_avg += 1
+        net.iteration += 1
+        net.score_value = jnp.mean(losses)
+        if self._since_avg >= self.averaging_frequency:
+            self._stacked = self._jit_helpers["avg"](self._stacked)
+            self._since_avg = 0
+        # Sync the canonical trees every round (post-average they hold the
+        # averaged values; mid-window, replica 0's — the per-worker view a
+        # reference listener would see), so Checkpoint/Evaluative listeners
+        # never observe parameters stale by a whole averaging window.
+        self._sync_net_from_stacked()
+        for lst in net.listeners:
+            lst.iteration_done(net, net.iteration)
+
+    def _sync_net_from_stacked(self):
+        net = self.model
+        params, opt, state = self._jit_helpers["take0"](self._stacked)
+        net.params_tree, net.opt_state = params, opt
+        if hasattr(net, "_commit_state"):
+            net._commit_state(state)
+        else:
+            net.state_tree = state
+        net._rng = self._stacked_rngs[0]
+        self._synced_params_ref = net.params_tree
+
+    def _average_and_sync(self):
+        """Average params/updater-state/layer-state across replicas and
+        refresh the net's canonical (unstacked) trees."""
+        self._stacked = self._jit_helpers["avg"](self._stacked)
+        self._since_avg = 0
+        self._sync_net_from_stacked()
+
+    def finalize(self):
+        """Flush pending local steps: average if mid-window and sync the
+        net. The reference averages once more when fit() drains
+        (ParallelWrapper.java:231-263)."""
+        if self._stacked is not None and self._since_avg > 0:
+            self._average_and_sync()
+
     # --------------------------------------------------------------- shutdown
     def shutdown(self):
-        """Reference ParallelWrapper.shutdown(): nothing to tear down here —
-        no threads were harmed in this design."""
+        """Reference ParallelWrapper.shutdown(): averages any pending local
+        window, then forgets placement. No threads were harmed in this
+        design."""
+        self.finalize()
         self._placed = False
+        self._stacked = None
+        self._stacked_rngs = None
 
 
 class ParallelWrapperBuilder:
