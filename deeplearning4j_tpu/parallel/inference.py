@@ -1,0 +1,267 @@
+"""ParallelInference: multi-client serving with dynamic batching.
+
+Reference parity: parallelism/ParallelInference.java:33-126 — N model
+replicas behind a queue; `InferenceMode.SEQUENTIAL` round-robins whole
+requests over replicas, `InferenceMode.BATCHED` coalesces queued requests
+into one forward pass via BatchedInferenceObservable
+(inference/observers/BatchedInferenceObservable.java), each caller blocking
+until its slice of the result is ready.
+
+TPU-native redesign: replicas-as-threads make no sense when one jitted
+forward already saturates the chip — the win on TPU is BATCH SIZE (MXU
+utilization scales with rows). So BATCHED mode is the headline path: a
+collector thread drains the request queue, pads the coalesced batch to a
+power-of-two bucket (static shapes → a handful of XLA compilations, ever),
+runs ONE jitted forward, and scatters row slices back to the waiting
+callers. SEQUENTIAL mode runs each request as its own forward under a lock
+(the single-program analog of round-robin replicas — device order is
+preserved, which is the observable semantic of the reference mode).
+"""
+from __future__ import annotations
+
+import collections
+import enum
+import queue
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+
+class InferenceMode(enum.Enum):
+    """Reference parallelism/inference/InferenceMode.java."""
+    SEQUENTIAL = "sequential"
+    BATCHED = "batched"
+
+
+class _Request:
+    __slots__ = ("x", "event", "result", "error")
+
+    def __init__(self, x: np.ndarray):
+        self.x = x
+        self.event = threading.Event()
+        self.result: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
+
+
+def _next_bucket(n: int) -> int:
+    """Smallest power of two >= n (static-shape buckets keep XLA from
+    recompiling per request mix — the TPU analog of the reference's
+    variable dynamic batch)."""
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+class ParallelInference:
+    """Thread-safe serving facade over a trained MultiLayerNetwork /
+    ComputationGraph (reference ParallelInference.Builder surface)."""
+
+    def __init__(self, model, *, inference_mode: InferenceMode = InferenceMode.BATCHED,
+                 batch_limit: int = 32, queue_limit: int = 64,
+                 batch_timeout_ms: float = 2.0):
+        if not getattr(model, "_initialized", False):
+            raise RuntimeError("Model must be init()ed (or restored) before "
+                               "serving")
+        self.model = model
+        self.inference_mode = inference_mode
+        self.batch_limit = int(batch_limit)
+        self.batch_timeout_ms = float(batch_timeout_ms)
+        self._lock = threading.Lock()
+        self._enqueue_lock = threading.Lock()
+        self._queue: "queue.Queue[_Request]" = queue.Queue(maxsize=queue_limit)
+        self._shutdown = False
+        self._worker: Optional[threading.Thread] = None
+        # Observability: recent executed batch sizes (bounded — a serving
+        # object lives for days) + a lifetime forward counter.
+        self.executed_batch_sizes = collections.deque(maxlen=1024)
+        self.total_forwards = 0
+        if inference_mode == InferenceMode.BATCHED:
+            self._worker = threading.Thread(
+                target=self._collector_loop, name="ParallelInference-collector",
+                daemon=True)
+            self._worker.start()
+
+    # ---------------------------------------------------------------- builder
+    @staticmethod
+    def builder(model) -> "ParallelInferenceBuilder":
+        return ParallelInferenceBuilder(model)
+
+    # ----------------------------------------------------------------- output
+    def output(self, x) -> np.ndarray:
+        """Predict for one request (any leading batch size). Thread-safe;
+        in BATCHED mode blocks until the coalesced forward containing this
+        request completes (reference output() → observable wait)."""
+        x = np.asarray(x)
+        if x.ndim == 0:
+            raise ValueError("Request must have a leading batch dimension")
+        if self.inference_mode == InferenceMode.SEQUENTIAL:
+            if self._shutdown:
+                raise RuntimeError("ParallelInference has been shut down")
+            with self._lock:
+                return self._forward(x)
+        req = _Request(x)
+        # Enqueue under the same lock shutdown() uses to place its sentinel,
+        # so no request can ever land BEHIND the sentinel and starve.
+        with self._enqueue_lock:
+            if self._shutdown:
+                raise RuntimeError("ParallelInference has been shut down")
+            try:
+                self._queue.put_nowait(req)
+            except queue.Full:
+                raise RuntimeError(
+                    f"ParallelInference queue limit ({self._queue.maxsize}) "
+                    "exceeded — server overloaded") from None
+        req.event.wait()
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    def _forward(self, x: np.ndarray) -> np.ndarray:
+        return self.model.output(x)
+
+    # -------------------------------------------------------------- collector
+    def _collector_loop(self):
+        try:
+            self._collect()
+        except BaseException as e:
+            # Collector must never die silently: mark the server down and
+            # fail every queued caller so nobody waits forever.
+            self._shutdown = True
+            while True:
+                try:
+                    r = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if r is not None:
+                    r.error = e
+                    r.event.set()
+            raise
+
+    def _collect(self):
+        while True:
+            try:
+                first = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                if self._shutdown:
+                    return
+                continue
+            if first is None:  # shutdown sentinel: serve stragglers, exit
+                self._drain_and_exit()
+                return
+            batch = [first]
+            rows = first.x.shape[0]
+            # Linger briefly for co-arriving requests (the reference's
+            # observable window), then drain whatever is queued.
+            threading.Event().wait(self.batch_timeout_ms / 1000.0)
+            saw_sentinel = False
+            while rows < self.batch_limit:
+                try:
+                    nxt = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    saw_sentinel = True
+                    break
+                batch.append(nxt)
+                rows += nxt.x.shape[0]
+            self._run_batch(batch)
+            if saw_sentinel:
+                self._drain_and_exit()
+                return
+
+    def _drain_and_exit(self):
+        """Serve every request still queued at shutdown (none can arrive
+        after the sentinel — enqueue holds the same lock)."""
+        leftovers = []
+        while True:
+            try:
+                r = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if r is not None:
+                leftovers.append(r)
+        if leftovers:
+            self._run_batch(leftovers)
+
+    def _run_batch(self, batch: List[_Request]):
+        try:
+            xs = np.concatenate([r.x for r in batch], axis=0)
+            n = xs.shape[0]
+            bucket = _next_bucket(n)
+            if bucket > n:
+                pad = np.repeat(xs[-1:], bucket - n, axis=0)
+                xs = np.concatenate([xs, pad], axis=0)
+            with self._lock:
+                out = self._forward(xs)
+            self.executed_batch_sizes.append(n)
+            self.total_forwards += 1
+            ofs = 0
+            for r in batch:
+                k = r.x.shape[0]
+                r.result = out[ofs:ofs + k]
+                ofs += k
+                r.event.set()
+        except BaseException as e:
+            if len(batch) == 1:
+                batch[0].error = e
+                batch[0].event.set()
+                return
+            # One bad request must not poison its batchmates: retry each
+            # request alone so only the offender sees the error (the
+            # reference's observables fail independently).
+            for r in batch:
+                self._run_batch([r])
+
+    # --------------------------------------------------------------- shutdown
+    def shutdown(self):
+        with self._enqueue_lock:
+            if self._shutdown:
+                return
+            self._shutdown = True
+            if self._worker is not None:
+                # May briefly block if the queue is full; the collector
+                # keeps draining without this lock, so it always frees up.
+                self._queue.put(None)
+        if self._worker is not None:
+            self._worker.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+
+
+class ParallelInferenceBuilder:
+    """Fluent builder mirroring reference ParallelInference.Builder."""
+
+    def __init__(self, model):
+        self._model = model
+        self._mode = InferenceMode.BATCHED
+        self._batch_limit = 32
+        self._queue_limit = 64
+        self._timeout_ms = 2.0
+
+    def inference_mode(self, mode: InferenceMode):
+        self._mode = mode
+        return self
+
+    def batch_limit(self, n: int):
+        self._batch_limit = int(n)
+        return self
+
+    def queue_limit(self, n: int):
+        self._queue_limit = int(n)
+        return self
+
+    def batch_timeout_ms(self, ms: float):
+        self._timeout_ms = float(ms)
+        return self
+
+    def build(self) -> ParallelInference:
+        return ParallelInference(
+            self._model, inference_mode=self._mode,
+            batch_limit=self._batch_limit, queue_limit=self._queue_limit,
+            batch_timeout_ms=self._timeout_ms)

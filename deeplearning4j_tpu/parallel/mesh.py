@@ -55,6 +55,29 @@ def data_parallel_mesh(num_devices: Optional[int] = None, devices=None) -> Mesh:
     return create_mesh([len(devices)], (DATA_AXIS,), devices)
 
 
+def is_multiprocess(mesh: Mesh) -> bool:
+    """True when the mesh spans devices owned by more than one process
+    (multi-host: the Spark-cluster analog, ICI/DCN instead of shuffle)."""
+    return jax.process_count() > 1 and \
+        any(d.process_index != jax.process_index() for d in mesh.devices.flat)
+
+
+def place(arr, sharding: NamedSharding, mesh: Mesh):
+    """Place an array under a sharding, multiprocess-safe.
+
+    Single-process: plain device_put. Multi-process: device_put cannot
+    address remote devices, so the global array is assembled from each
+    process's local portion (for batch-sharded data: this process's
+    partition; for replicated: the full host copy) — the TPU-native
+    analog of the Spark driver broadcasting NetBroadcastTuple
+    (ParameterAveragingTrainingMaster.java:346-357)."""
+    if arr is None:
+        return None
+    if is_multiprocess(mesh):
+        return jax.make_array_from_process_local_data(sharding, np.asarray(arr))
+    return jax.device_put(arr, sharding)
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 
@@ -65,17 +88,20 @@ def batch_sharded(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
 
 
 def shard_batch(mesh: Mesh, tree, axis: str = DATA_AXIS):
-    """Place a pytree of host arrays on the mesh, batch-dim sharded."""
+    """Place a pytree of host arrays on the mesh, batch-dim sharded. In a
+    multi-process mesh each process passes its LOCAL partition and the
+    global batch is their concatenation in process order."""
     sh = batch_sharded(mesh, axis)
     return jax.tree_util.tree_map(
-        lambda x: None if x is None else jax.device_put(x, sh), tree,
-        is_leaf=lambda x: x is None)
+        lambda x: place(x, sh, mesh), tree, is_leaf=lambda x: x is None)
 
 
 def replicate(mesh: Mesh, tree):
-    """Replicate a pytree of arrays across the whole mesh."""
+    """Replicate a pytree of arrays across the whole mesh (every process
+    must hold the same values — true after same-seed init or checkpoint
+    restore)."""
     sh = replicated(mesh)
-    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), tree)
+    return jax.tree_util.tree_map(lambda x: place(x, sh, mesh), tree)
 
 
 def pad_batch_to_multiple(arr: np.ndarray, multiple: int) -> Tuple[np.ndarray, int]:

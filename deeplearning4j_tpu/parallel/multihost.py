@@ -1,0 +1,169 @@
+"""Multi-host training runner: the Spark-driver / TrainingMaster role.
+
+Reference parity: dl4j-spark's SparkDl4jMultiLayer.fit(JavaRDD) →
+ParameterAveragingTrainingMaster (ParameterAveragingTrainingMaster.java:
+346-357 split sizing, :867-896 treeAggregate + param/updater averaging) —
+a driver JVM broadcasts (conf, params, updaterState) to executor JVMs,
+each executor trains on its RDD partition, results aggregate over the
+Spark shuffle.
+
+TPU-native redesign: there is no driver/executor asymmetry. Every host
+runs the SAME SPMD program over a global jax.sharding.Mesh spanning all
+processes' devices (jax.distributed); XLA collectives over ICI (intra-
+slice) / DCN (inter-slice) replace the broadcast + treeAggregate
+transport. "Broadcast" degenerates to same-seed init (or same checkpoint)
++ replicated placement; "aggregate" is the gradient allreduce (sync DP,
+averaging_frequency=1) or the every-F-steps parameter average (local SGD)
+that ParallelWrapper already implements — this runner only adds the
+process bootstrap, per-process data partitioning contract, lockstep
+guards, and chief-only checkpointing.
+
+Launch contract (one process per host, like one Spark executor per node):
+
+    runner = MultiHostRunner(coordinator_address="host0:1234",
+                             num_processes=4, process_id=rank)
+    runner.initialize()
+    net = MultiLayerNetwork(conf).init(seed=SAME_EVERYWHERE)
+    runner.fit(net, local_x, local_y, epochs=..., batch_size=...)
+    runner.save_checkpoint(net, "gs://.../model.zip")   # chief writes
+
+Env fallbacks: JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
+JAX_PROCESS_ID. On TPU pods, pass auto_detect=True to let jax's cluster
+detection fill everything in.
+"""
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+
+from . import mesh as mesh_lib
+from .wrapper import ParallelWrapper
+
+log = logging.getLogger(__name__)
+
+
+class MultiHostRunner:
+    def __init__(self, coordinator_address: Optional[str] = None,
+                 num_processes: Optional[int] = None,
+                 process_id: Optional[int] = None,
+                 auto_detect: bool = False):
+        self.coordinator_address = coordinator_address or \
+            os.environ.get("JAX_COORDINATOR_ADDRESS")
+        self.num_processes = num_processes if num_processes is not None else \
+            int(os.environ["JAX_NUM_PROCESSES"]) \
+            if "JAX_NUM_PROCESSES" in os.environ else None
+        self.process_id = process_id if process_id is not None else \
+            int(os.environ["JAX_PROCESS_ID"]) \
+            if "JAX_PROCESS_ID" in os.environ else None
+        self.auto_detect = auto_detect
+        self._initialized = False
+        self._mesh = None
+
+    # ------------------------------------------------------------- bootstrap
+    def initialize(self) -> "MultiHostRunner":
+        """Join the cluster (idempotent). jax.distributed.initialize must
+        run BEFORE any jax call that touches the backend, so this method
+        makes no jax queries until after the join. Explicit
+        coordinator/num/id is the spark-master-URL analog; auto_detect=True
+        defers entirely to jax's cluster detection (TPU pods)."""
+        if self._initialized:
+            return self
+        if self.num_processes is not None and self.num_processes > 1:
+            jax.distributed.initialize(
+                coordinator_address=self.coordinator_address,
+                num_processes=self.num_processes,
+                process_id=self.process_id)
+        elif self.auto_detect:
+            jax.distributed.initialize(
+                coordinator_address=self.coordinator_address)
+        self._initialized = True
+        log.info("MultiHostRunner: process %d/%d, %d local / %d global devices",
+                 jax.process_index(), jax.process_count(),
+                 jax.local_device_count(), jax.device_count())
+        return self
+
+    @property
+    def is_chief(self) -> bool:
+        """Process 0 — the only writer for checkpoints/logs (the driver
+        role's one surviving asymmetry)."""
+        return jax.process_index() == 0
+
+    def mesh(self):
+        """Global data-parallel mesh over every device of every process."""
+        if self._mesh is None:
+            self.initialize()
+            self._mesh = mesh_lib.create_mesh(
+                [jax.device_count()], (mesh_lib.DATA_AXIS,), jax.devices())
+        return self._mesh
+
+    # ------------------------------------------------------------- lockstep
+    def _assert_lockstep(self, *values: int):
+        """All processes must agree on loop bounds, or SPMD deadlocks
+        (the Spark analog: TrainingMaster sizes every split identically)."""
+        if jax.process_count() == 1:
+            return
+        from jax.experimental import multihost_utils
+        mine = np.asarray(values, np.int64)
+        all_vals = multihost_utils.process_allgather(mine)
+        if not (all_vals == all_vals[0]).all():
+            raise ValueError(
+                f"Processes disagree on batch/epoch counts: {all_vals.tolist()}"
+                " — every process must feed identically-shaped local "
+                "partitions (repartition your data)")
+
+    def barrier(self, name: str = "barrier"):
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(name)
+
+    # ------------------------------------------------------------------- fit
+    def fit(self, model, local_features, local_labels=None, *,
+            epochs: int = 1, batch_size: int = 32,
+            averaging_frequency: int = 1) -> ParallelWrapper:
+        """Train over the global mesh; THIS process contributes
+        `local_features/labels` (its partition — the executor's RDD split).
+        Global batch per step = batch_size × num_processes."""
+        import math
+        wrapper = ParallelWrapper(model, mesh=self.mesh(),
+                                  averaging_frequency=averaging_frequency)
+        if hasattr(local_features, "num_examples"):     # DataSet
+            n = local_features.num_examples()
+        elif hasattr(local_features, "shape"):          # array
+            n = np.asarray(local_features).shape[0]
+        else:                                           # opaque iterator
+            n = -1  # caller must guarantee equal batch counts per process
+        if n >= 0:
+            self._assert_lockstep(math.ceil(n / batch_size), epochs)
+        else:
+            self._assert_lockstep(epochs)
+        # Delegate the epoch/listener loop to the net's own fit (via the
+        # wrapper) so loop semantics exist in exactly one place.
+        wrapper.fit(local_features, local_labels, epochs=epochs,
+                    batch_size=batch_size)
+        return wrapper
+
+    # ------------------------------------------------------------ checkpoint
+    def save_checkpoint(self, model, path: str):
+        """Chief-only write + cluster barrier (reference: only the Spark
+        driver persists, ModelSerializer.java:37-127)."""
+        self.barrier("pre-checkpoint")
+        if self.is_chief:
+            from ..utils.model_serializer import ModelSerializer
+            ModelSerializer.write_model(model, path)
+        self.barrier("post-checkpoint")
+
+    def materialize_local(self, model):
+        """Pull the model's (replicated) trees back to process-local
+        arrays so single-process inference/eval works after training."""
+        import jax.numpy as jnp
+        to_local = lambda t: jax.tree_util.tree_map(
+            lambda a: jnp.asarray(np.asarray(a)), t)
+        model.params_tree = to_local(model.params_tree)
+        model.opt_state = to_local(model.opt_state)
+        model.state_tree = to_local(model.state_tree)
+        model._rng = jnp.asarray(np.asarray(model._rng))
+        return model

@@ -55,6 +55,19 @@ class ParallelWrapper:
                 f"ParallelWrapper needs a mesh with a '{mesh_lib.DATA_AXIS}' "
                 f"axis; got axes {self.mesh.axis_names}")
         self.data_shards = int(self.mesh.shape[mesh_lib.DATA_AXIS])
+        # Multi-host: every process feeds its LOCAL data partition; the
+        # global batch is their concatenation (Spark partition semantics).
+        self.multiprocess = mesh_lib.is_multiprocess(self.mesh)
+        if self.multiprocess:
+            nproc = jax.process_count()
+            if self.data_shards % nproc != 0 or self.data_shards < nproc:
+                raise ValueError(
+                    f"multi-host mesh: data axis size ({self.data_shards}) "
+                    f"must be a positive multiple of the process count "
+                    f"({nproc}) so every process owns an equal slice")
+            self.local_shards = self.data_shards // nproc
+        else:
+            self.local_shards = self.data_shards
         if int(averaging_frequency) < 1:
             raise ValueError("averaging_frequency must be >= 1")
         self.averaging_frequency = int(averaging_frequency)
@@ -76,16 +89,38 @@ class ParallelWrapper:
 
     def _place_model(self):
         """Replicate params/opt/state across the mesh once (the reference
-        clones the model per device at zoo creation, ParallelWrapper:460)."""
+        clones the model per device at zoo creation, ParallelWrapper:460).
+        Multi-host, this is the broadcast-NetBroadcastTuple analog: every
+        process holds identical values (same-seed init or restore) and the
+        assembled global arrays are replicated over all devices."""
         net = self.model
         net.params_tree = mesh_lib.replicate(self.mesh, net.params_tree)
         net.opt_state = mesh_lib.replicate(self.mesh, net.opt_state)
         net.state_tree = mesh_lib.replicate(self.mesh, net.state_tree)
+        net._rng = mesh_lib.replicate(self.mesh, net._rng)
         self._placed = True
+
+    def _check_local_divisible(self, n: int):
+        """Multi-host SPMD requires every process to compile and run the
+        SAME program — per-process zero-weight pad masks could differ
+        between processes, so non-divisible local batches are rejected
+        (the reference repartitions to balance, BalancedPartitioner)."""
+        if n % self.local_shards != 0:
+            raise ValueError(
+                f"multi-host training requires the per-process batch ({n}) "
+                f"to be divisible by the process-local shard count "
+                f"({self.local_shards}); repartition your data")
 
     def _shard_arr(self, a, cast_dtype=None):
         if a is None:
             return None
+        if self.multiprocess:
+            a = np.asarray(a)
+            self._check_local_divisible(a.shape[0])
+            if cast_dtype is not None and a.dtype.kind == "f":
+                a = a.astype(cast_dtype)
+            return mesh_lib.place(a, mesh_lib.batch_sharded(self.mesh),
+                                  self.mesh)
         if isinstance(a, jax.Array) and a.shape[0] % self.data_shards == 0:
             # Already device-resident and evenly divisible: reshard
             # device-to-device, never touching the host.
@@ -180,7 +215,9 @@ class ParallelWrapper:
         net = self.model
         inputs, labels, fm, lm = net._pack(net._coerce(ds))
         n = next(iter(inputs.values())).shape[0]
-        if n % self.data_shards != 0:
+        if self.multiprocess:
+            self._check_local_divisible(n)
+        elif n % self.data_shards != 0:
             # Every output head gets a zero-weight mask over pad rows.
             lm = {name: self._pad_lmask(lm.get(name), n) for name in labels}
         return inputs, labels, fm, lm, n
@@ -190,7 +227,9 @@ class ParallelWrapper:
         over the mesh's data axis, then delegate invoke+commit to the net
         so the commit tail can never diverge from the single-device path."""
         net = self.model
-        if x.shape[0] % self.data_shards != 0:
+        if self.multiprocess:
+            self._check_local_divisible(x.shape[0])
+        elif x.shape[0] % self.data_shards != 0:
             lmask = self._pad_lmask(lmask, x.shape[0])
         net._run_and_commit(
             self._shard_arr(x, cast_dtype=net._dtype), self._shard_arr(y),
@@ -226,10 +265,15 @@ class ParallelWrapper:
         def take0(t):  # replicas are equal post-average; unstack view
             return tmap(lambda a: a[0], t)
 
+        # take0 outputs replicate so they stay addressable on every process
+        # (replica 0's device may be remote under multi-host).
         self._jit_helpers = {
             "stack": jax.jit(stack, out_shardings=stacked_sh),
             "avg": jax.jit(avg, out_shardings=stacked_sh),
-            "take0": jax.jit(take0),
+            "take0": jax.jit(take0,
+                             out_shardings=mesh_lib.replicated(self.mesh)),
+            "split_rngs": jax.jit(lambda k: jax.random.split(k, W),
+                                  out_shardings=stacked_sh),
         }
 
     def _ensure_stacked(self, n_data_args: int):
@@ -243,13 +287,13 @@ class ParallelWrapper:
             self._stacked = None
         if self._stacked_step is None:
             self._build_local_machinery(n_data_args)
+        if not self._placed:
+            self._place_model()  # stack-jit inputs must be mesh-global
         h = self._jit_helpers
         self._stacked = h["stack"]((net.params_tree, net.opt_state,
                                     self._net_state_tree()))
         self._synced_params_ref = net.params_tree
-        rngs = jax.random.split(net._rng, self.data_shards)
-        self._stacked_rngs = jax.device_put(
-            rngs, mesh_lib.batch_sharded(self.mesh))
+        self._stacked_rngs = h["split_rngs"](net._rng)
         self._since_avg = 0
 
     def _net_state_tree(self):
@@ -264,6 +308,14 @@ class ParallelWrapper:
         if a is None:
             return None
         W = self.data_shards
+        if self.multiprocess:
+            # Local rows → (local_shards, chunk, ...); the global replica
+            # axis (W rows) is assembled across processes.
+            a = np.asarray(a)
+            self._check_local_divisible(a.shape[0])
+            stacked = a.reshape((self.local_shards, -1) + a.shape[1:])
+            return mesh_lib.place(stacked, mesh_lib.batch_sharded(self.mesh),
+                                  self.mesh)
         if isinstance(a, jax.Array):
             pad = (-a.shape[0]) % W
             if pad:
@@ -299,7 +351,9 @@ class ParallelWrapper:
             x, y = ds.features, ds.labels
             fmask, lmask = ds.features_mask, ds.labels_mask
             n = np.asarray(x).shape[0]
-            if n % self.data_shards != 0:
+            if self.multiprocess:
+                self._check_local_divisible(n)
+            elif n % self.data_shards != 0:
                 lmask = self._pad_lmask(lmask, n)
             x = np.asarray(x)
             if x.dtype.kind == "f":
@@ -330,13 +384,14 @@ class ParallelWrapper:
 
     def _sync_net_from_stacked(self):
         net = self.model
-        params, opt, state = self._jit_helpers["take0"](self._stacked)
+        (params, opt, state), rng = self._jit_helpers["take0"](
+            (self._stacked, self._stacked_rngs))
         net.params_tree, net.opt_state = params, opt
         if hasattr(net, "_commit_state"):
             net._commit_state(state)
         else:
             net.state_tree = state
-        net._rng = self._stacked_rngs[0]
+        net._rng = rng
         self._synced_params_ref = net.params_tree
 
     def _average_and_sync(self):

@@ -1,0 +1,68 @@
+"""Worker entry for the multi-host test: one Spark-executor-analog process.
+
+Invoked by test_multihost.py as
+    python multihost_worker.py <process_id> <num_processes> <port>
+Each process contributes 2 CPU devices and its own data partition; the
+final parameter vector is printed for cross-process / vs-single-device
+comparison. (The reference's analogous test trains Spark local[N] vs a
+single machine — TestCompareParameterAveragingSparkVsSingleMachine.)"""
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_force_host_platform_device_count=2"
+
+pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from deeplearning4j_tpu import (DenseLayer, InputType, MultiLayerNetwork,  # noqa: E402
+                                NeuralNetConfiguration, Nesterovs, OutputLayer)
+from deeplearning4j_tpu.parallel import MultiHostRunner  # noqa: E402
+
+
+def build_net():
+    conf = (NeuralNetConfiguration.builder().seed(7)
+            .updater(Nesterovs(0.1, momentum=0.9))
+            .list()
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(8)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def partition(p):
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((64, 8)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, size=64)]
+    # Global batch k = concat(proc0 rows, proc1 rows): interleave halves so
+    # each process's batch b of size 16 is rows [b*32+p*16 : b*32+(p+1)*16].
+    xs = x.reshape(2, 32, 8)[:, p * 16:(p + 1) * 16].reshape(32, 8)
+    ys = y.reshape(2, 32, 3)[:, p * 16:(p + 1) * 16].reshape(32, 3)
+    return xs, ys
+
+
+runner = MultiHostRunner(coordinator_address=f"127.0.0.1:{port}",
+                         num_processes=nproc, process_id=pid).initialize()
+assert jax.device_count() == 2 * nproc, jax.device_count()
+
+# Phase 1: synchronous DP (averaging_frequency=1), 2 epochs of 2 batches.
+net = build_net()
+xs, ys = partition(pid)
+runner.fit(net, xs, ys, epochs=2, batch_size=16)
+runner.materialize_local(net)
+print(f"SYNC {pid} {float(np.abs(net.params()).sum()):.6f}", flush=True)
+
+# Phase 2: local SGD (averaging_frequency=2) across hosts.
+net2 = build_net()
+runner.fit(net2, xs, ys, epochs=2, batch_size=16, averaging_frequency=2)
+runner.materialize_local(net2)
+print(f"LOCAL {pid} {float(np.abs(net2.params()).sum()):.6f}", flush=True)
+
+runner.barrier("done")
+print(f"DONE {pid}", flush=True)

@@ -198,6 +198,70 @@ class TestLocalSGD:
         assert float(g.score_value) < s0
 
 
+class TestParallelInference:
+    """ParallelInference parity (ParallelInference.java:33-126): SEQUENTIAL
+    = per-request forwards; BATCHED = dynamic batching where concurrent
+    callers' requests coalesce into one forward pass."""
+
+    def _trained_net(self):
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        net.fit(_data(64), epochs=2, batch_size=32)
+        return net
+
+    def test_sequential_matches_direct_output(self):
+        from deeplearning4j_tpu.parallel import (InferenceMode,
+                                                 ParallelInference)
+        net = self._trained_net()
+        x = _data(16, seed=2).features
+        with ParallelInference.builder(net).inference_mode(
+                InferenceMode.SEQUENTIAL).build() as pi:
+            np.testing.assert_allclose(pi.output(x), net.output(x),
+                                       rtol=1e-6)
+
+    def test_batched_concurrent_requests_coalesce(self):
+        import threading
+        from deeplearning4j_tpu.parallel import ParallelInference
+        net = self._trained_net()
+        xs = [_data(1, seed=100 + i).features for i in range(24)]
+        expected = [net.output(x) for x in xs]
+        results = [None] * len(xs)
+        with ParallelInference.builder(net).batch_limit(16) \
+                .batch_timeout_ms(20).build() as pi:
+            # Warm the jitted buckets first so all threads coalesce into
+            # few forwards instead of serializing on first-compile.
+            pi.output(xs[0])
+
+            def run(i):
+                results[i] = pi.output(xs[i])
+
+            threads = [threading.Thread(target=run, args=(i,))
+                       for i in range(len(xs))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            sizes = list(pi.executed_batch_sizes)
+        for got, want in zip(results, expected):
+            np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+        # Dynamic batching actually coalesced: fewer forwards than requests.
+        assert max(sizes) > 1
+        assert len(sizes) < 1 + len(xs)
+
+    def test_batched_multirow_requests_and_errors(self):
+        from deeplearning4j_tpu.parallel import ParallelInference
+        net = self._trained_net()
+        x = _data(5, seed=42).features
+        with ParallelInference.builder(net).build() as pi:
+            out = pi.output(x)
+            assert out.shape == (5, 3)
+            np.testing.assert_allclose(out, net.output(x), rtol=1e-5,
+                                       atol=1e-6)
+            with pytest.raises(Exception):
+                pi.output(np.zeros((2, 999), np.float32))  # bad width
+        with pytest.raises(RuntimeError):
+            pi.output(x)  # after shutdown
+
+
 class TestGraftEntry:
     def test_entry_compiles(self):
         import __graft_entry__ as g
