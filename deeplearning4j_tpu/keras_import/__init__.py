@@ -1,0 +1,9 @@
+"""Keras HDF5 model import (reference deeplearning4j-modelimport, §2.8).
+
+    from deeplearning4j_tpu.keras_import import KerasModelImport
+    net = KerasModelImport.import_keras_sequential_model_and_weights("m.h5")
+    graph = KerasModelImport.import_keras_model_and_weights("m.h5")
+"""
+from .model_import import KerasModelImport
+from .reader import (Hdf5Archive, InvalidKerasConfigurationException,
+                     UnsupportedKerasConfigurationException)

@@ -23,6 +23,7 @@ import collections
 import enum
 import queue
 import threading
+import time
 from typing import List, Optional
 
 import numpy as np
@@ -126,17 +127,19 @@ class ParallelInference:
         try:
             self._collect()
         except BaseException as e:
-            # Collector must never die silently: mark the server down and
-            # fail every queued caller so nobody waits forever.
-            self._shutdown = True
-            while True:
-                try:
-                    r = self._queue.get_nowait()
-                except queue.Empty:
-                    break
-                if r is not None:
-                    r.error = e
-                    r.event.set()
+            # Collector must never die silently: mark the server down
+            # (under the enqueue lock so no request can slip in after the
+            # drain) and fail every queued caller so nobody waits forever.
+            with self._enqueue_lock:
+                self._shutdown = True
+                while True:
+                    try:
+                        r = self._queue.get_nowait()
+                    except queue.Empty:
+                        break
+                    if r is not None:
+                        r.error = e
+                        r.event.set()
             raise
 
     def _collect(self):
@@ -153,8 +156,10 @@ class ParallelInference:
             batch = [first]
             rows = first.x.shape[0]
             # Linger briefly for co-arriving requests (the reference's
-            # observable window), then drain whatever is queued.
-            threading.Event().wait(self.batch_timeout_ms / 1000.0)
+            # observable window) — unless this request alone already fills
+            # the batch — then drain whatever is queued.
+            if rows < self.batch_limit:
+                time.sleep(self.batch_timeout_ms / 1000.0)
             saw_sentinel = False
             while rows < self.batch_limit:
                 try:

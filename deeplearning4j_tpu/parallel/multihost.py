@@ -62,6 +62,18 @@ class MultiHostRunner:
         self.auto_detect = auto_detect
         self._initialized = False
         self._mesh = None
+        self._wrappers = {}  # (id(model), avg_freq) → ParallelWrapper
+
+    def _wrapper_for(self, model, averaging_frequency: int) -> ParallelWrapper:
+        """Reuse one wrapper per (model, frequency) so repeated fit calls
+        keep their jitted helpers instead of recompiling every time."""
+        key = (id(model), int(averaging_frequency))
+        w = self._wrappers.get(key)
+        if w is None or w.model is not model:
+            w = ParallelWrapper(model, mesh=self.mesh(),
+                                averaging_frequency=averaging_frequency)
+            self._wrappers[key] = w
+        return w
 
     # ------------------------------------------------------------- bootstrap
     def initialize(self) -> "MultiHostRunner":
@@ -127,9 +139,7 @@ class MultiHostRunner:
         """Train over the global mesh; THIS process contributes
         `local_features/labels` (its partition — the executor's RDD split).
         Global batch per step = batch_size × num_processes."""
-        import math
-        wrapper = ParallelWrapper(model, mesh=self.mesh(),
-                                  averaging_frequency=averaging_frequency)
+        wrapper = self._wrapper_for(model, averaging_frequency)
         if hasattr(local_features, "num_examples"):     # DataSet
             n = local_features.num_examples()
         elif hasattr(local_features, "shape"):          # array
@@ -137,7 +147,10 @@ class MultiHostRunner:
         else:                                           # opaque iterator
             n = -1  # caller must guarantee equal batch counts per process
         if n >= 0:
-            self._assert_lockstep(math.ceil(n / batch_size), epochs)
+            # n itself must match (not just the batch COUNT): unequal
+            # last-batch sizes compile different SPMD programs and hang the
+            # cluster at the collective.
+            self._assert_lockstep(n, batch_size, epochs)
         else:
             self._assert_lockstep(epochs)
         # Delegate the epoch/listener loop to the net's own fit (via the

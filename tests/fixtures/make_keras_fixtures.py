@@ -1,0 +1,98 @@
+"""Generate Keras .h5 fixtures + recorded predictions for import tests.
+
+Run once (TF/Keras only needed here, not at test time):
+    python tests/fixtures/make_keras_fixtures.py
+Writes tests/fixtures/keras/*.h5 and expected.npz — the analog of the
+reference's committed fixture models for KerasModelEndToEndTest.java."""
+import os
+
+os.environ["CUDA_VISIBLE_DEVICES"] = "-1"
+
+import numpy as np  # noqa: E402
+
+import keras  # noqa: E402
+from keras import layers  # noqa: E402
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+OUT = os.path.join(HERE, "keras")
+os.makedirs(OUT, exist_ok=True)
+
+rng = np.random.default_rng(42)
+expected = {}
+
+
+def save(model, name, x):
+    model.save(os.path.join(OUT, f"{name}.h5"))
+    y = model.predict(x, verbose=0)
+    expected[f"{name}_x"] = x
+    expected[f"{name}_y"] = y
+
+
+keras.utils.set_random_seed(7)
+
+# 1. Sequential MLP (compiled → has training_config)
+mlp = keras.Sequential([
+    keras.Input((8,)),
+    layers.Dense(16, activation="relu", name="d1"),
+    layers.Dense(8, activation="tanh", name="d2"),
+    layers.Dense(3, activation="softmax", name="out"),
+])
+mlp.compile(loss="categorical_crossentropy", optimizer="adam")
+save(mlp, "mlp", rng.standard_normal((5, 8)).astype(np.float32))
+
+# 2. Sequential CNN: conv/pool/BN/flatten/dense on 12x12x1 channels_last
+cnn = keras.Sequential([
+    keras.Input((12, 12, 1)),
+    layers.Conv2D(8, 3, padding="same", activation="relu", name="c1"),
+    layers.MaxPooling2D(2, name="p1"),
+    layers.Conv2D(16, 3, padding="valid", activation="linear", name="c2"),
+    layers.BatchNormalization(name="bn"),
+    layers.Activation("relu", name="a1"),
+    layers.ZeroPadding2D(1, name="zp"),
+    layers.AveragePooling2D(2, name="p2"),
+    layers.Flatten(name="fl"),
+    layers.Dropout(0.25, name="dr"),
+    layers.Dense(10, activation="softmax", name="out"),
+])
+cnn.compile(loss="categorical_crossentropy", optimizer="sgd")
+# Give BN non-trivial moving stats by running a couple of train steps.
+xtr = rng.standard_normal((32, 12, 12, 1)).astype(np.float32)
+ytr = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 32)]
+cnn.fit(xtr, ytr, epochs=2, batch_size=16, verbose=0)
+save(cnn, "cnn", rng.standard_normal((4, 12, 12, 1)).astype(np.float32))
+
+# 3. Sequential stacked LSTM → global pooling → dense
+lstm = keras.Sequential([
+    keras.Input((6, 5)),
+    layers.LSTM(12, return_sequences=True, name="l1"),
+    layers.LSTM(8, return_sequences=True, name="l2"),
+    layers.GlobalAveragePooling1D(name="gp"),
+    layers.Dense(4, activation="softmax", name="out"),
+])
+lstm.compile(loss="categorical_crossentropy", optimizer="adam")
+save(lstm, "lstm", rng.standard_normal((3, 6, 5)).astype(np.float32))
+
+# 4. Functional: two branches, Concatenate + Add merges
+inp = keras.Input((8,), name="in0")
+a = layers.Dense(16, activation="relu", name="da")(inp)
+b = layers.Dense(16, activation="tanh", name="db")(inp)
+cat = layers.Concatenate(name="cat")([a, b])
+add = layers.Add(name="add")([a, b])
+both = layers.Concatenate(name="cat2")([cat, add])
+outf = layers.Dense(3, activation="softmax", name="out")(both)
+func = keras.Model(inp, outf)
+func.compile(loss="categorical_crossentropy", optimizer="adam")
+save(func, "functional", rng.standard_normal((5, 8)).astype(np.float32))
+
+# 5. Functional: LSTM(return_sequences=False) → last-time-step semantics
+inp2 = keras.Input((7, 4), name="seq_in")
+h = layers.LSTM(10, return_sequences=False, name="lstm")(inp2)
+out2 = layers.Dense(2, activation="softmax", name="out")(h)
+lstm_last = keras.Model(inp2, out2)
+lstm_last.compile(loss="categorical_crossentropy", optimizer="adam")
+save(lstm_last, "lstm_last", rng.standard_normal((3, 7, 4)).astype(np.float32))
+
+np.savez(os.path.join(OUT, "expected.npz"), **expected)
+print("Wrote fixtures to", OUT)
+for k in sorted(expected):
+    print(" ", k, expected[k].shape)

@@ -1,0 +1,94 @@
+"""Keras HDF5 import end-to-end tests (reference
+KerasModelEndToEndTest.java: fixture .h5 models must import and predict
+within tolerance of the recorded Keras outputs).
+
+Fixtures are committed under tests/fixtures/keras/ (regenerate with
+tests/fixtures/make_keras_fixtures.py — needs TF/Keras, tests don't)."""
+import os
+
+import numpy as np
+import pytest
+
+h5py = pytest.importorskip("h5py")
+
+from deeplearning4j_tpu.keras_import import (  # noqa: E402
+    InvalidKerasConfigurationException, KerasModelImport)
+
+FIX = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures",
+                   "keras")
+
+
+@pytest.fixture(scope="module")
+def expected():
+    return np.load(os.path.join(FIX, "expected.npz"))
+
+
+def _h5(name):
+    return os.path.join(FIX, f"{name}.h5")
+
+
+class TestSequentialImport:
+    def test_mlp_predicts_like_keras(self, expected):
+        net = KerasModelImport.import_keras_sequential_model_and_weights(
+            _h5("mlp"))
+        out = net.output(expected["mlp_x"])
+        np.testing.assert_allclose(out, expected["mlp_y"], rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_mlp_terminal_layer_is_trainable_head(self, expected):
+        """Compiled-with-crossentropy model imports with a loss head so
+        fit() works out of the box (KerasModel.java:522-527 semantics)."""
+        net = KerasModelImport.import_keras_sequential_model_and_weights(
+            _h5("mlp"))
+        x = expected["mlp_x"]
+        y = np.eye(3, dtype=np.float32)[np.arange(len(x)) % 3]
+        before = net.score(x=x, y=y)
+        net.fit(x, y, epochs=30, batch_size=len(x))
+        assert net.score(x=x, y=y) < before
+
+    def test_cnn_predicts_like_keras(self, expected):
+        """Conv/pool/BN(with moving stats)/zeropad/flatten path, NHWC
+        channels_last — weight copy without any transposition."""
+        net = KerasModelImport.import_keras_sequential_model_and_weights(
+            _h5("cnn"))
+        out = net.output(expected["cnn_x"])
+        np.testing.assert_allclose(out, expected["cnn_y"], rtol=1e-3,
+                                   atol=1e-4)
+
+    def test_lstm_predicts_like_keras(self, expected):
+        """Stacked LSTM: keras gate blocks [i,f,c,o] reordered to the
+        framework's [c,f,o,i] packing."""
+        net = KerasModelImport.import_keras_sequential_model_and_weights(
+            _h5("lstm"))
+        out = net.output(expected["lstm_x"])
+        np.testing.assert_allclose(out, expected["lstm_y"], rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_functional_rejected_by_sequential_api(self):
+        with pytest.raises(InvalidKerasConfigurationException):
+            KerasModelImport.import_keras_sequential_model_and_weights(
+                _h5("functional"))
+
+
+class TestGraphImport:
+    def test_functional_merges_predict_like_keras(self, expected):
+        graph = KerasModelImport.import_keras_model_and_weights(
+            _h5("functional"))
+        out = graph.output(expected["functional_x"])
+        np.testing.assert_allclose(out, expected["functional_y"], rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_lstm_return_sequences_false_last_step(self, expected):
+        """LSTM(return_sequences=False) imports as LSTM + last-time-step
+        vertex."""
+        graph = KerasModelImport.import_keras_model_and_weights(
+            _h5("lstm_last"))
+        out = graph.output(expected["lstm_last_x"])
+        np.testing.assert_allclose(out, expected["lstm_last_y"], rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_sequential_also_imports_as_graph(self, expected):
+        graph = KerasModelImport.import_keras_model_and_weights(_h5("mlp"))
+        out = graph.output(expected["mlp_x"])
+        np.testing.assert_allclose(out, expected["mlp_y"], rtol=1e-4,
+                                   atol=1e-5)
