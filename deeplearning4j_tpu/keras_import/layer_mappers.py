@@ -197,10 +197,12 @@ def map_layer(class_name: str, cfg: dict, *,
             weights=_dense_weights)
 
     if class_name in ("Conv1D", "Convolution1D"):
+        _require_channels_last(cfg)
         return Mapped(conv.Convolution1DLayer(
             name=name, n_out=int(cfg["filters"]),
             kernel_size=(int(_pair(cfg["kernel_size"])[0]),),
             stride=(int(_pair(cfg.get("strides", 1))[0]),),
+            dilation=(int(_pair(cfg.get("dilation_rate", 1))[0]),),
             convolution_mode=_conv_mode(cfg), activation=_act_of(cfg)),
             weights=_dense_weights)
 

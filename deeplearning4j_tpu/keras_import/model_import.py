@@ -76,6 +76,11 @@ def _set_weights(tree_params: dict, tree_state: dict, mapped: Mapped,
                  kw: Dict[str, np.ndarray], dtype):
     """Overwrite one layer's initialized params/state with Keras values,
     shape-checked (reference helperCopyWeightsToModel, KerasModel.java:662)."""
+    if mapped.weights is not None and tree_params and not kw:
+        raise InvalidKerasConfigurationException(
+            f"No weights found in the h5 file for layer "
+            f"{mapped.layer.name!r} — silently keeping random init would "
+            "produce garbage predictions")
     new_p = dict(tree_params)
     if mapped.weights is not None and kw:
         for pname, arr in mapped.weights(kw).items():
@@ -127,12 +132,37 @@ class KerasModelImport:
                  if lc["class_name"] not in
                  ("InputLayer", "Activation", "Dropout", "Flatten")),
                 default=-1)
+            # Dense → Activation('softmax') tail (a very common Keras
+            # idiom): fold the trailing activation INTO the loss head, so
+            # the imported net both trains on post-activation outputs and
+            # ends in an output layer as MultiLayerNetwork requires.
+            terminal_act = None
+            fold_idx = None
+            if 0 <= last_param_idx < len(layer_cfgs) - 1:
+                trailing = [(i, lc) for i, lc in
+                            enumerate(layer_cfgs[last_param_idx + 1:],
+                                      last_param_idx + 1)
+                            if lc["class_name"] == "Activation"]
+                if len(trailing) == 1 and trailing[0][0] == len(layer_cfgs) - 1:
+                    from .layer_mappers import map_activation
+                    fold_idx = trailing[0][0]
+                    terminal_act = map_activation(
+                        trailing[0][1]["config"].get("activation", "linear"))
             for i, lc in enumerate(layer_cfgs):
+                if i == fold_idx:
+                    continue  # folded into the terminal loss head
                 shape = _batch_shape(lc)
                 if shape is not None and input_type is None:
                     input_type = _input_type_from_shape(shape)
                 m = map_layer(lc["class_name"], lc.get("config", {}),
                               is_terminal=(i == last_param_idx), loss=loss)
+                if i == last_param_idx and terminal_act is not None and \
+                        m.layer is not None:
+                    m.layer.activation = terminal_act
+                    if loss is None and hasattr(m.layer, "loss"):
+                        from .layer_mappers import _LOSS_BY_ACTIVATION
+                        m.layer.loss = _LOSS_BY_ACTIVATION.get(
+                            terminal_act, "mse")
                 if getattr(m, "return_sequences", True) is False:
                     raise UnsupportedKerasConfigurationException(
                         "LSTM(return_sequences=False) needs a last-time-step "
@@ -190,7 +220,20 @@ class KerasModelImport:
 
     @staticmethod
     def _sequential_as_graph(cfg):
-        layer_cfgs = cfg["config"]["layers"]
+        layer_cfgs = list(cfg["config"]["layers"])
+        if layer_cfgs and layer_cfgs[0]["class_name"] != "InputLayer":
+            # Keras 2.x Sequential h5: no InputLayer entry — the first
+            # real layer carries batch_input_shape. Synthesize the input
+            # node so the first layer is NOT mistaken for a graph input
+            # (which would silently drop it and its weights).
+            shape = _batch_shape(layer_cfgs[0])
+            if shape is None:
+                raise InvalidKerasConfigurationException(
+                    "Sequential model without InputLayer or "
+                    "batch_input_shape on its first layer")
+            layer_cfgs.insert(0, {"class_name": "InputLayer",
+                                  "config": {"name": "__keras_input__",
+                                             "batch_shape": shape}})
         names = []
         inbound = {}
         prev = None

@@ -1,0 +1,6 @@
+"""NLP: word/doc/graph embeddings + text pipeline (reference
+deeplearning4j-nlp-parent, SURVEY.md §2.5)."""
+from .glove import Glove
+from .paragraph_vectors import LabelsSource, ParagraphVectors
+from .serializer import WordVectorSerializer
+from .word2vec import Word2Vec, WordVectors

@@ -1,0 +1,208 @@
+"""GloVe: global vectors from co-occurrence statistics.
+
+Reference parity: models/glove/Glove.java (429 LoC builder facade) +
+models/glove/count/ (co-occurrence counting) + the AdaGrad element update
+in models/embeddings/learning/impl/elements/GloVe.java:
+    J = sum_ij f(X_ij) (w_i·w~_j + b_i + b~_j − log X_ij)^2,
+    f(x) = (x/x_max)^alpha clipped at 1.
+
+TPU-native redesign: counting stays host-side (a hash-map scan, exactly
+the reference's RoundCount/CountMap role); the optimization loop becomes
+batched jitted AdaGrad steps over COO (i, j, X_ij) triples — gather rows,
+autodiff the weighted squared error, scatter-add gradients, AdaGrad
+per-row state. Same objective, deterministic batch schedule.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .sentence_iterator import SentenceIterator
+from .tokenization import DefaultTokenizerFactory
+from .vocab import VocabCache, VocabConstructor
+from .word2vec import WordVectors
+
+
+def cooccurrence_counts(indexed_sentences, window: int = 5,
+                        symmetric: bool = True,
+                        distance_weighted: bool = True
+                        ) -> Dict[Tuple[int, int], float]:
+    """Weighted co-occurrence map (reference glove/count pipeline;
+    1/distance weighting per the GloVe paper and
+    AbstractCoOccurrences.java)."""
+    counts: Dict[Tuple[int, int], float] = {}
+    for ids in indexed_sentences:
+        n = len(ids)
+        for pos in range(n):
+            for off in range(1, window + 1):
+                j = pos + off
+                if j >= n:
+                    break
+                w = 1.0 / off if distance_weighted else 1.0
+                a, b = int(ids[pos]), int(ids[j])
+                counts[(a, b)] = counts.get((a, b), 0.0) + w
+                if symmetric:
+                    counts[(b, a)] = counts.get((b, a), 0.0) + w
+    return counts
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _glove_step(tables, accum, rows, cols, logx, fx, lr):
+    """One batched AdaGrad step on COO triples.
+
+    tables = {"W": [V,D], "Wt": [V,D], "b": [V], "bt": [V]}; accum mirrors
+    tables with AdaGrad sum-of-squares state (reference GloVe.java uses
+    ND4J AdaGrad per element)."""
+
+    def loss_fn(t):
+        wi = jnp.take(t["W"], rows, axis=0)
+        wj = jnp.take(t["Wt"], cols, axis=0)
+        bi = jnp.take(t["b"], rows)
+        bj = jnp.take(t["bt"], cols)
+        diff = jnp.sum(wi * wj, axis=-1) + bi + bj - logx
+        return 0.5 * jnp.sum(fx * diff * diff), diff
+
+    (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(tables)
+    new_t, new_a = {}, {}
+    for k in tables:
+        g = grads[k]
+        a2 = accum[k] + g * g
+        new_t[k] = tables[k] - lr * g / jnp.sqrt(a2 + 1e-8)
+        new_a[k] = a2
+    return new_t, new_a, loss / rows.shape[0]
+
+
+class Glove(WordVectors):
+    """Builder-configured GloVe trainer (reference Glove.Builder)."""
+
+    def __init__(self, **kw):
+        self._kw = kw
+        self.vocab = None
+        self._vectors = None
+        self._normed = None
+        self.last_loss: Optional[float] = None
+
+    @staticmethod
+    def builder() -> "GloveBuilder":
+        return GloveBuilder()
+
+    def fit(self) -> "Glove":
+        kw = self._kw
+        it = kw["iterate"]
+        tf = kw.get("tokenizer_factory", DefaultTokenizerFactory())
+        tokenized = [tf.create(s).get_tokens() for s in it]
+        cache = VocabConstructor(
+            min_word_frequency=kw.get("min_word_frequency", 1)).build(
+                tokenized)
+        self.vocab = cache
+        indexed = []
+        for tokens in tokenized:
+            ids = [cache.index_of(t) for t in tokens]
+            ids = [i for i in ids if i >= 0]
+            if ids:
+                indexed.append(np.asarray(ids, np.int32))
+
+        counts = cooccurrence_counts(
+            indexed, window=kw.get("window_size", 5),
+            symmetric=kw.get("symmetric", True))
+        if not counts:
+            raise ValueError("Empty co-occurrence matrix (corpus too small)")
+        coo = np.array([(i, j, x) for (i, j), x in counts.items()],
+                       np.float64)
+        rows = coo[:, 0].astype(np.int32)
+        cols = coo[:, 1].astype(np.int32)
+        xs = coo[:, 2]
+        x_max = float(kw.get("x_max", 100.0))
+        alpha = float(kw.get("alpha", 0.75))
+        fx = np.minimum(1.0, (xs / x_max) ** alpha).astype(np.float32)
+        logx = np.log(xs).astype(np.float32)
+
+        V, D = len(cache), int(kw.get("layer_size", 100))
+        rng = np.random.default_rng(kw.get("seed", 42))
+        tables = {
+            "W": jnp.asarray(rng.uniform(-0.5 / D, 0.5 / D, (V, D)),
+                             jnp.float32),
+            "Wt": jnp.asarray(rng.uniform(-0.5 / D, 0.5 / D, (V, D)),
+                              jnp.float32),
+            "b": jnp.zeros((V,), jnp.float32),
+            "bt": jnp.zeros((V,), jnp.float32),
+        }
+        accum = {k: jnp.zeros_like(v) for k, v in tables.items()}
+
+        lr = jnp.asarray(kw.get("learning_rate", 0.05), jnp.float32)
+        B = int(kw.get("batch_size", 4096))
+        n = len(rows)
+        for _ in range(kw.get("epochs", 25)):
+            order = rng.permutation(n)
+            for s in range(0, n, B):
+                sl = order[s:s + B]
+                tables, accum, loss = _glove_step(
+                    tables, accum, jnp.asarray(rows[sl]),
+                    jnp.asarray(cols[sl]), jnp.asarray(logx[sl]),
+                    jnp.asarray(fx[sl]), lr)
+            self.last_loss = float(loss)
+
+        # Standard GloVe: final embedding = W + Wt (paper §4.2; reference
+        # exposes syn0 only, lookupTable).
+        self._vectors = np.asarray(tables["W"]) + np.asarray(tables["Wt"])
+        self._normed = None
+        return self
+
+
+class GloveBuilder:
+    """Fluent builder mirroring reference Glove.Builder names."""
+
+    def __init__(self):
+        self._kw = {}
+
+    def _set(self, k, v):
+        self._kw[k] = v
+        return self
+
+    def iterate(self, it):
+        from .sentence_iterator import CollectionSentenceIterator
+        if isinstance(it, (list, tuple)):
+            it = CollectionSentenceIterator(it)
+        return self._set("iterate", it)
+
+    def tokenizer_factory(self, tf):
+        return self._set("tokenizer_factory", tf)
+
+    def layer_size(self, n):
+        return self._set("layer_size", int(n))
+
+    def window_size(self, n):
+        return self._set("window_size", int(n))
+
+    def min_word_frequency(self, n):
+        return self._set("min_word_frequency", int(n))
+
+    def learning_rate(self, lr):
+        return self._set("learning_rate", float(lr))
+
+    def epochs(self, n):
+        return self._set("epochs", int(n))
+
+    def batch_size(self, n):
+        return self._set("batch_size", int(n))
+
+    def x_max(self, x):
+        return self._set("x_max", float(x))
+
+    def alpha(self, a):
+        return self._set("alpha", float(a))
+
+    def symmetric(self, b):
+        return self._set("symmetric", bool(b))
+
+    def seed(self, s):
+        return self._set("seed", int(s))
+
+    def build(self) -> Glove:
+        if "iterate" not in self._kw:
+            raise ValueError("Glove.builder(): call iterate(...) first")
+        return Glove(**self._kw)

@@ -1,0 +1,362 @@
+"""ParagraphVectors (doc2vec): DBOW + DM with inferVector.
+
+Reference parity: models/paragraphvectors/ParagraphVectors.java (1,439 LoC
+facade incl. inferVector), models/embeddings/learning/impl/sequence/
+{DBOW.java, DM.java} (document-level learning over the SkipGram/CBOW
+element kernels), text/documentiterator/LabelsSource (doc label
+assignment).
+
+TPU-native redesign: same batched-device-step scheme as embeddings.py —
+  * DBOW: the element objective with the DOCUMENT vector as the predictor
+    (reference DBOW delegates to SkipGram with the label's vector);
+    mathematically skip-gram where `centers` index a doc table.
+  * DM: CBOW where the averaged context includes the doc vector
+    (reference DM.java averages label + context rows).
+  * inferVector: freeze word/output tables, SGD only the one fresh doc row
+    (reference ParagraphVectors.inferVector), jitted with lax.fori_loop.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .embeddings import (_hs_step, _ns_step, _row_scale, codes_points_arrays,
+                         generate_pairs, sentences_to_indices)
+from .sentence_iterator import SentenceIterator
+from .tokenization import DefaultTokenizerFactory
+from .vocab import VocabCache, VocabConstructor, unigram_table
+from .word2vec import WordVectors
+
+
+class LabelsSource:
+    """Doc label bookkeeping (reference text/documentiterator/
+    LabelsSource.java): auto-generates DOC_<n> or records given labels."""
+
+    def __init__(self, template: str = "DOC_%d"):
+        self.template = template
+        self.labels: List[str] = []
+        self._index: Dict[str, int] = {}
+
+    def next_label(self) -> str:
+        label = self.template % len(self.labels)
+        self.add(label)
+        return label
+
+    def add(self, label: str) -> int:
+        if label not in self._index:
+            self._index[label] = len(self.labels)
+            self.labels.append(label)
+        return self._index[label]
+
+    def index_of(self, label: str) -> int:
+        return self._index.get(label, -1)
+
+    def __len__(self):
+        return len(self.labels)
+
+
+@functools.partial(jax.jit, static_argnames=("steps",))
+def _infer_ns(doc, syn1neg, targets, negatives, lrs, steps: int):
+    """inferVector (NS): SGD the single doc row; tables frozen."""
+
+    def body(i, d):
+        def loss_fn(dv):
+            pos = jnp.take(syn1neg, targets, axis=0)
+            neg = jnp.take(syn1neg, negatives[i], axis=0)
+            tmask = (targets >= 0).astype(dv.dtype)
+            pos_s = pos @ dv
+            neg_s = neg @ dv
+            return -((jax.nn.log_sigmoid(pos_s) * tmask).sum()
+                     + jnp.where(tmask[:, None] > 0,
+                                 jax.nn.log_sigmoid(-neg_s), 0.0).sum())
+        g = jax.grad(loss_fn)(d)
+        denom = jnp.clip((targets >= 0).sum().astype(d.dtype), 1.0)
+        return d - lrs[i] * g / denom
+    return jax.lax.fori_loop(0, steps, body, doc)
+
+
+@functools.partial(jax.jit, static_argnames=("steps",))
+def _infer_hs(doc, syn1, codes, points, lrs, steps: int):
+    """inferVector (HS): SGD the single doc row against huffman paths."""
+
+    def body(i, d):
+        def loss_fn(dv):
+            cmask = (codes >= 0).astype(dv.dtype)
+            pts = jnp.take(syn1, jnp.maximum(points, 0), axis=0)  # [N,L,D]
+            score = jnp.einsum("d,nld->nl", dv, pts)
+            sign = 1.0 - 2.0 * jnp.maximum(codes, 0).astype(dv.dtype)
+            return -(jax.nn.log_sigmoid(sign * score) * cmask).sum()
+        g = jax.grad(loss_fn)(d)
+        denom = jnp.clip((codes[:, 0] >= 0).sum().astype(d.dtype), 1.0)
+        return d - lrs[i] * g / denom
+    return jax.lax.fori_loop(0, steps, body, doc)
+
+
+class ParagraphVectors(WordVectors):
+    """Builder-configured doc2vec (reference ParagraphVectors.Builder)."""
+
+    def __init__(self, **kw):
+        self._kw = kw
+        self.labels_source: LabelsSource = kw.get("labels_source",
+                                                  LabelsSource())
+        self._doc_vectors: Optional[np.ndarray] = None
+        self._trainer = None
+        self.vocab = None
+        self._vectors = None
+        self._normed = None
+
+    @staticmethod
+    def builder() -> "ParagraphVectorsBuilder":
+        return ParagraphVectorsBuilder()
+
+    # ------------------------------------------------------------------ fit
+    def fit(self) -> "ParagraphVectors":
+        kw = self._kw
+        it = kw["iterate"]
+        tf = kw.get("tokenizer_factory", DefaultTokenizerFactory())
+        labels = kw.get("labels")
+
+        docs = [tf.create(s).get_tokens() for s in it]
+        if labels is None:
+            labels = [self.labels_source.next_label() for _ in docs]
+        else:
+            for lb in labels:
+                self.labels_source.add(lb)
+        if len(labels) != len(docs):
+            raise ValueError(f"{len(labels)} labels for {len(docs)} docs")
+
+        cache = VocabConstructor(
+            min_word_frequency=kw.get("min_word_frequency", 1)).build(docs)
+        self.vocab = cache
+
+        from .embeddings import BatchedEmbeddingTrainer
+        self._trainer = BatchedEmbeddingTrainer(
+            cache,
+            layer_size=kw.get("layer_size", 100),
+            window=kw.get("window_size", 5),
+            negative=kw.get("negative", 0),
+            use_hierarchic_softmax=kw.get("use_hierarchic_softmax", True),
+            cbow=False,
+            learning_rate=kw.get("learning_rate", 0.025),
+            min_learning_rate=kw.get("min_learning_rate", 1e-4),
+            batch_size=kw.get("batch_size", 1024),
+            sampling=kw.get("sampling", 0.0),
+            seed=kw.get("seed", 42))
+        trainer = self._trainer
+        indexed = sentences_to_indices(docs, cache)
+        # One doc may die in indexing (all tokens sub-min-frequency): keep
+        # alignment doc-row ↔ label by re-indexing with empties preserved.
+        indexed_all = []
+        for tokens in docs:
+            ids = [cache.index_of(t) for t in tokens]
+            indexed_all.append(np.array([i for i in ids if i >= 0],
+                                        dtype=np.int32))
+
+        epochs = kw.get("epochs", 1) * kw.get("iterations", 1)
+        if kw.get("train_word_vectors", True) and any(
+                len(ids) > 1 for ids in indexed):
+            trainer.fit_sentences(indexed, epochs=epochs)
+
+        self._fit_docs(indexed_all, epochs)
+        self._vectors = trainer.vectors()
+        self._normed = None
+        return self
+
+    def _fit_docs(self, indexed_docs, epochs: int):
+        """DBOW (sequence algorithm 'dbow') or DM ('dm') passes over the
+        doc table, sharing the trainer's output tables."""
+        kw = self._kw
+        trainer = self._trainer
+        rng = np.random.default_rng(kw.get("seed", 42) + 1)
+        D = trainer.layer_size
+        n_docs = len(indexed_docs)
+        key = jax.random.PRNGKey(kw.get("seed", 42) + 1)
+        doc_tab = jax.random.uniform(key, (n_docs, D), jnp.float32,
+                                     -0.5 / D, 0.5 / D)
+        algo = kw.get("sequence_learning_algorithm", "dbow").lower()
+        window = trainer.window
+        lr0 = trainer.lr
+
+        steps_per_epoch = max(1, sum(len(ids) for ids in indexed_docs)
+                              // trainer.batch_size + 1)
+        total = max(1, epochs * steps_per_epoch)
+        step = 0
+        for _ in range(epochs):
+            # (doc_id, target word) training pairs
+            if algo == "dbow":
+                # every word of the doc is predicted from the doc vector
+                dids, tgts = [], []
+                for d, ids in enumerate(indexed_docs):
+                    dids.extend([d] * len(ids))
+                    tgts.extend(ids.tolist())
+            elif algo == "dm":
+                # DM ~ skip-gram pairs with doc vector as extra predictor;
+                # here doc vector alone predicts context around each word
+                # then averages with the word (see divergence note below).
+                dids, tgts = [], []
+                for d, ids in enumerate(indexed_docs):
+                    c, ctx = generate_pairs([ids], window, rng)
+                    dids.extend([d] * len(ctx))
+                    tgts.extend(ctx.tolist())
+            else:
+                raise ValueError(f"Unknown sequence algorithm {algo!r}")
+            if not dids:
+                continue
+            dids = np.asarray(dids, np.int32)
+            tgts = np.asarray(tgts, np.int32)
+            order = rng.permutation(len(dids))
+            dids, tgts = dids[order], tgts[order]
+            B = trainer.batch_size
+            for start in range(0, len(dids), B):
+                end = min(start + B, len(dids))
+                lr = max(trainer.min_lr, lr0 * (1.0 - step / total))
+                dc = jnp.asarray(dids[start:end])
+                tg = jnp.asarray(tgts[start:end])
+                if trainer.use_hs:
+                    t = tgts[start:end]
+                    tables = {"syn0": doc_tab, "syn1": trainer.tables["syn1"]}
+                    tables, _ = _hs_step(
+                        tables, dc, tg, jnp.asarray(trainer._codes[t]),
+                        jnp.asarray(trainer._points[t]),
+                        jnp.asarray(lr, jnp.float32))
+                    doc_tab = tables["syn0"]
+                    trainer.tables["syn1"] = tables["syn1"]
+                if trainer.negative > 0:
+                    negs = rng.choice(trainer._unigram,
+                                      size=(end - start, trainer.negative))
+                    tables = {"syn0": doc_tab,
+                              "syn1neg": trainer.tables["syn1neg"]}
+                    tables, _ = _ns_step(
+                        tables, dc, tg, jnp.asarray(negs, jnp.int32),
+                        jnp.asarray(lr, jnp.float32))
+                    doc_tab = tables["syn0"]
+                    trainer.tables["syn1neg"] = tables["syn1neg"]
+                step += 1
+        self._doc_vectors = np.asarray(doc_tab)
+
+    # -------------------------------------------------------------- queries
+    def doc_vector(self, label: str) -> Optional[np.ndarray]:
+        i = self.labels_source.index_of(label)
+        if i < 0 or self._doc_vectors is None:
+            return None
+        return self._doc_vectors[i]
+
+    def similarity_docs(self, label1: str, label2: str) -> float:
+        a, b = self.doc_vector(label1), self.doc_vector(label2)
+        if a is None or b is None:
+            return float("nan")
+        denom = np.linalg.norm(a) * np.linalg.norm(b)
+        return float(a @ b / denom) if denom else 0.0
+
+    def infer_vector(self, text_or_tokens, iterations: int = 50,
+                     learning_rate: float = 0.025,
+                     min_learning_rate: float = 1e-4) -> np.ndarray:
+        """Embed an UNSEEN document: fresh doc row trained against frozen
+        tables (reference ParagraphVectors.inferVector)."""
+        if self._trainer is None:
+            raise RuntimeError("Call fit() before infer_vector()")
+        kw = self._kw
+        tf = kw.get("tokenizer_factory", DefaultTokenizerFactory())
+        tokens = (text_or_tokens if isinstance(text_or_tokens, (list, tuple))
+                  else tf.create(text_or_tokens).get_tokens())
+        ids = np.array([i for i in (self.vocab.index_of(t) for t in tokens)
+                        if i >= 0], np.int32)
+        trainer = self._trainer
+        D = trainer.layer_size
+        rng = np.random.default_rng(abs(hash(tuple(ids.tolist()))) % (2**31))
+        doc = jnp.asarray(rng.uniform(-0.5 / D, 0.5 / D, D), jnp.float32)
+        lrs = jnp.asarray(np.maximum(
+            min_learning_rate,
+            learning_rate * (1.0 - np.arange(iterations) / iterations)),
+            jnp.float32)
+        if len(ids) == 0:
+            return np.asarray(doc)
+        if trainer.use_hs:
+            doc = _infer_hs(doc, trainer.tables["syn1"],
+                            jnp.asarray(trainer._codes[ids]),
+                            jnp.asarray(trainer._points[ids]), lrs,
+                            int(iterations))
+        if trainer.negative > 0:
+            negs = rng.choice(trainer._unigram,
+                              size=(iterations, len(ids), trainer.negative))
+            doc = _infer_ns(doc, trainer.tables["syn1neg"],
+                            jnp.asarray(ids), jnp.asarray(negs, jnp.int32),
+                            lrs, int(iterations))
+        return np.asarray(doc)
+
+
+class ParagraphVectorsBuilder:
+    """Fluent builder mirroring reference ParagraphVectors.Builder."""
+
+    def __init__(self):
+        self._kw = {}
+
+    def _set(self, k, v):
+        self._kw[k] = v
+        return self
+
+    def iterate(self, it):
+        from .sentence_iterator import CollectionSentenceIterator
+        if isinstance(it, (list, tuple)):
+            it = CollectionSentenceIterator(it)
+        return self._set("iterate", it)
+
+    def labels(self, labels: Sequence[str]):
+        return self._set("labels", list(labels))
+
+    def labels_source(self, src: LabelsSource):
+        return self._set("labels_source", src)
+
+    def tokenizer_factory(self, tf):
+        return self._set("tokenizer_factory", tf)
+
+    def layer_size(self, n):
+        return self._set("layer_size", int(n))
+
+    def window_size(self, n):
+        return self._set("window_size", int(n))
+
+    def min_word_frequency(self, n):
+        return self._set("min_word_frequency", int(n))
+
+    def negative_sample(self, n):
+        return self._set("negative", int(n))
+
+    def use_hierarchic_softmax(self, b=True):
+        return self._set("use_hierarchic_softmax", bool(b))
+
+    def sequence_learning_algorithm(self, name: str):
+        """'dbow' (PV-DBOW) or 'dm' (PV-DM) — reference
+        setSequenceLearningAlgorithm(DBOW/DM class names)."""
+        return self._set("sequence_learning_algorithm",
+                         name.rsplit(".", 1)[-1].lower())
+
+    def train_word_vectors(self, b: bool):
+        return self._set("train_word_vectors", bool(b))
+
+    def learning_rate(self, lr):
+        return self._set("learning_rate", float(lr))
+
+    def min_learning_rate(self, lr):
+        return self._set("min_learning_rate", float(lr))
+
+    def epochs(self, n):
+        return self._set("epochs", int(n))
+
+    def iterations(self, n):
+        return self._set("iterations", int(n))
+
+    def batch_size(self, n):
+        return self._set("batch_size", int(n))
+
+    def seed(self, s):
+        return self._set("seed", int(s))
+
+    def build(self) -> ParagraphVectors:
+        if "iterate" not in self._kw:
+            raise ValueError("ParagraphVectors.builder(): call iterate(...)")
+        return ParagraphVectors(**self._kw)

@@ -33,6 +33,7 @@ detection fill everything in.
 """
 from __future__ import annotations
 
+import collections
 import logging
 import os
 from typing import Optional
@@ -62,17 +63,24 @@ class MultiHostRunner:
         self.auto_detect = auto_detect
         self._initialized = False
         self._mesh = None
-        self._wrappers = {}  # (id(model), avg_freq) → ParallelWrapper
+        # Bounded LRU: wrappers pin their models, so an unbounded cache
+        # would leak every model ever fit (hyperparameter sweeps).
+        self._wrappers = collections.OrderedDict()
+        self._wrapper_cache_size = 4
 
     def _wrapper_for(self, model, averaging_frequency: int) -> ParallelWrapper:
         """Reuse one wrapper per (model, frequency) so repeated fit calls
         keep their jitted helpers instead of recompiling every time."""
         key = (id(model), int(averaging_frequency))
         w = self._wrappers.get(key)
-        if w is None or w.model is not model:
-            w = ParallelWrapper(model, mesh=self.mesh(),
-                                averaging_frequency=averaging_frequency)
-            self._wrappers[key] = w
+        if w is not None and w.model is model:
+            self._wrappers.move_to_end(key)
+            return w
+        w = ParallelWrapper(model, mesh=self.mesh(),
+                            averaging_frequency=averaging_frequency)
+        self._wrappers[key] = w
+        while len(self._wrappers) > self._wrapper_cache_size:
+            self._wrappers.popitem(last=False)
         return w
 
     # ------------------------------------------------------------- bootstrap

@@ -92,6 +92,16 @@ lstm_last = keras.Model(inp2, out2)
 lstm_last.compile(loss="categorical_crossentropy", optimizer="adam")
 save(lstm_last, "lstm_last", rng.standard_normal((3, 7, 4)).astype(np.float32))
 
+# 6. Sequential with the Dense → Activation('softmax') tail idiom
+act_tail = keras.Sequential([
+    keras.Input((8,)),
+    layers.Dense(12, activation="relu", name="h"),
+    layers.Dense(3, name="logits"),
+    layers.Activation("softmax", name="sm"),
+])
+act_tail.compile(loss="categorical_crossentropy", optimizer="adam")
+save(act_tail, "act_tail", rng.standard_normal((5, 8)).astype(np.float32))
+
 np.savez(os.path.join(OUT, "expected.npz"), **expected)
 print("Wrote fixtures to", OUT)
 for k in sorted(expected):

@@ -64,6 +64,44 @@ class TestSequentialImport:
         np.testing.assert_allclose(out, expected["lstm_y"], rtol=1e-4,
                                    atol=1e-5)
 
+    def test_activation_tail_folds_into_loss_head(self, expected):
+        """Dense → Activation('softmax') tail: activation folds into the
+        terminal loss head, net stays trainable and parity holds."""
+        net = KerasModelImport.import_keras_sequential_model_and_weights(
+            _h5("act_tail"))
+        out = net.output(expected["act_tail_x"])
+        np.testing.assert_allclose(out, expected["act_tail_y"], rtol=1e-4,
+                                   atol=1e-5)
+        x = expected["act_tail_x"]
+        y = np.eye(3, dtype=np.float32)[np.arange(len(x)) % 3]
+        before = net.score(x=x, y=y)
+        net.fit(x, y, epochs=20, batch_size=len(x))
+        assert net.score(x=x, y=y) < before
+
+    def test_keras2_style_sequential_without_input_layer(self, tmp_path,
+                                                         expected):
+        """Keras 2.x h5 (no InputLayer; batch_input_shape on the first
+        layer) must not drop the first layer when imported as a graph."""
+        import json
+        import h5py
+        src, dst = _h5("mlp"), str(tmp_path / "k2.h5")
+        import shutil
+        shutil.copy(src, dst)
+        with h5py.File(dst, "r+") as f:
+            cfg = json.loads(f.attrs["model_config"])
+            lays = cfg["config"]["layers"]
+            assert lays[0]["class_name"] == "InputLayer"
+            shape = lays[0]["config"].get("batch_shape") or \
+                lays[0]["config"].get("batch_input_shape")
+            lays.pop(0)  # keras2: no InputLayer entry
+            lays[0]["config"]["batch_input_shape"] = shape
+            cfg["config"]["layers"] = lays
+            f.attrs["model_config"] = json.dumps(cfg)
+        graph = KerasModelImport.import_keras_model_and_weights(dst)
+        out = graph.output(expected["mlp_x"])
+        np.testing.assert_allclose(out, expected["mlp_y"], rtol=1e-4,
+                                   atol=1e-5)
+
     def test_functional_rejected_by_sequential_api(self):
         with pytest.raises(InvalidKerasConfigurationException):
             KerasModelImport.import_keras_sequential_model_and_weights(

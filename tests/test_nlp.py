@@ -145,3 +145,122 @@ class TestTrainerInternals:
         first = tr.last_loss
         tr.fit_sentences(idx, epochs=6)
         assert tr.last_loss < first
+
+
+class TestParagraphVectors:
+    """DBOW/DM doc vectors cluster by topic; inferVector lands near its
+    topic's training docs (reference ParagraphVectorsTest strategy)."""
+
+    def _fit(self, algo="dbow", **kw):
+        from deeplearning4j_tpu.nlp import ParagraphVectors
+        docs = two_topic_corpus(n=60, seed=1)
+        labels = [f"DOC_{i}" for i in range(len(docs))]
+        base = dict(layer_size=24, window_size=3, min_word_frequency=1,
+                    epochs=30, batch_size=256, learning_rate=0.1,
+                    min_learning_rate=0.01, seed=7, negative_sample=5,
+                    use_hierarchic_softmax=False)
+        base.update(kw)
+        b = (ParagraphVectors.builder().iterate(docs).labels(labels)
+             .sequence_learning_algorithm(algo))
+        for k, v in base.items():
+            getattr(b, k)(v)
+        return b.build().fit(), docs
+
+    @pytest.mark.parametrize("algo", ["dbow", "dm"])
+    def test_doc_vectors_cluster_by_topic(self, algo):
+        """Relative assertions (reference ParagraphVectorsTest style): doc
+        vectors share a large common component (the away-from-negatives
+        direction), so cluster structure shows in ORDERING, not absolute
+        cosine margins — each probe doc's nearest neighbor must be
+        same-topic."""
+        pv, docs = self._fit(algo)
+        # even indices = animal docs, odd = food docs
+        same = np.mean([pv.similarity_docs("DOC_0", f"DOC_{i}")
+                        for i in range(2, 20, 2)])
+        cross = np.mean([pv.similarity_docs("DOC_0", f"DOC_{i}")
+                         for i in range(1, 20, 2)])
+        assert same > cross, (algo, same, cross)
+        purity = 0
+        for probe in range(10):
+            sims = [(pv.similarity_docs(f"DOC_{probe}", f"DOC_{j}"), j)
+                    for j in range(40) if j != probe]
+            _, nearest = max(sims)
+            purity += (nearest % 2) == (probe % 2)
+        assert purity >= 8, (algo, purity)
+
+    def test_infer_vector_matches_topic(self):
+        pv, docs = self._fit("dbow")
+        inferred = pv.infer_vector("cat dog horse fish bird cat")
+
+        def cos(a, b):
+            return float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b)))
+        animal_sim = np.mean([cos(inferred, pv.doc_vector(f"DOC_{i}"))
+                              for i in range(0, 20, 2)])
+        food_sim = np.mean([cos(inferred, pv.doc_vector(f"DOC_{i}"))
+                            for i in range(1, 20, 2)])
+        assert animal_sim > food_sim, (animal_sim, food_sim)
+
+    def test_infer_vector_hs_path(self):
+        pv, docs = self._fit("dbow", negative_sample=0, use_hierarchic_softmax=True)
+        v = pv.infer_vector("bread cheese rice soup apple")
+        assert np.isfinite(v).all() and np.linalg.norm(v) > 0
+
+
+class TestGlove:
+    def test_glove_topic_similarity(self):
+        from deeplearning4j_tpu.nlp import Glove
+        g = (Glove.builder().iterate(two_topic_corpus(n=200, seed=2))
+             .layer_size(24).window_size(3).min_word_frequency(1)
+             .epochs(40).learning_rate(0.05).seed(11).build().fit())
+        same = g.similarity("cat", "dog")
+        cross = g.similarity("cat", "bread")
+        assert same > cross, (same, cross)
+        assert np.isfinite(g.last_loss)
+
+    def test_glove_nearest_words(self):
+        from deeplearning4j_tpu.nlp import Glove
+        g = (Glove.builder().iterate(two_topic_corpus(n=200, seed=3))
+             .layer_size(24).window_size(3).epochs(40).seed(5)
+             .build().fit())
+        near = g.words_nearest("cheese", top_n=4)
+        foods = {"bread", "apple", "rice", "soup"}
+        assert len(foods & set(near)) >= 3, near
+
+
+class TestWordVectorSerializer:
+    def _vectors(self):
+        return fit_w2v(negative_sample=5, use_hierarchic_softmax=False)
+
+    def test_text_roundtrip(self, tmp_path):
+        from deeplearning4j_tpu.nlp import WordVectorSerializer as S
+        wv = self._vectors()
+        p = str(tmp_path / "vecs.txt")
+        S.write_word_vectors(wv, p)
+        back = S.load_txt_vectors(p)
+        assert back.vocab.contains("cat")
+        np.testing.assert_allclose(back.word_vector("cat"),
+                                   wv.word_vector("cat"), rtol=1e-4,
+                                   atol=1e-5)
+        # similarity structure survives the round trip
+        assert back.similarity("cat", "dog") == pytest.approx(
+            wv.similarity("cat", "dog"), abs=1e-3)
+
+    def test_binary_roundtrip_bit_exact(self, tmp_path):
+        from deeplearning4j_tpu.nlp import WordVectorSerializer as S
+        wv = self._vectors()
+        p = str(tmp_path / "vecs.bin")
+        S.write_word2vec_model(wv, p, binary=True)
+        back = S.load_google_model(p, binary=True)
+        np.testing.assert_array_equal(
+            back.get_word_vector_matrix(),
+            np.asarray(wv.get_word_vector_matrix(), np.float32))
+        assert back.vocab.index_of("cat") == wv.vocab.index_of("cat")
+
+    def test_text_header_roundtrip(self, tmp_path):
+        from deeplearning4j_tpu.nlp import WordVectorSerializer as S
+        wv = self._vectors()
+        p = str(tmp_path / "vecs_hdr.txt.gz")  # gzip path too
+        S.write_word2vec_model(wv, p, binary=False)
+        back = S.load_google_model(p, binary=False)
+        np.testing.assert_allclose(back.get_word_vector_matrix(),
+                                   wv.get_word_vector_matrix(), rtol=1e-6)
