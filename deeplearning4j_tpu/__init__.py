@@ -19,6 +19,8 @@ from .nn.layers.convolution import (BatchNormalization, Convolution1DLayer,
                                     LocalResponseNormalization, PoolingType,
                                     Subsampling1DLayer, SubsamplingLayer,
                                     ZeroPaddingLayer)
+from .nn.layers.pretrain import (RBM, AutoEncoder, CenterLossOutputLayer,
+                                 VariationalAutoencoder)
 from .nn.layers.recurrent import (LSTM, GravesBidirectionalLSTM, GravesLSTM,
                                   RnnOutputLayer)
 from .nn.multilayer import MultiLayerNetwork

@@ -143,7 +143,14 @@ class KerasModelImport:
                             enumerate(layer_cfgs[last_param_idx + 1:],
                                       last_param_idx + 1)
                             if lc["class_name"] == "Activation"]
-                if len(trailing) == 1 and trailing[0][0] == len(layer_cfgs) - 1:
+                term_cfg = layer_cfgs[last_param_idx]
+                # Fold only when the param layer itself is LINEAR — folding
+                # over Dense(relu)→Activation(softmax) would silently drop
+                # the relu.
+                if len(trailing) == 1 and \
+                        trailing[0][0] == len(layer_cfgs) - 1 and \
+                        term_cfg.get("config", {}).get(
+                            "activation", "linear") == "linear":
                     from .layer_mappers import map_activation
                     fold_idx = trailing[0][0]
                     terminal_act = map_activation(

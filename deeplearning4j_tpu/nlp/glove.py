@@ -21,9 +21,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .sentence_iterator import SentenceIterator
 from .tokenization import DefaultTokenizerFactory
-from .vocab import VocabCache, VocabConstructor
+from .vocab import VocabConstructor
 from .word2vec import WordVectors
 
 

@@ -17,18 +17,17 @@ TPU-native redesign: same batched-device-step scheme as embeddings.py —
 """
 from __future__ import annotations
 
-import functools
 from typing import Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .embeddings import (_hs_step, _ns_step, _row_scale, codes_points_arrays,
-                         generate_pairs, sentences_to_indices)
-from .sentence_iterator import SentenceIterator
+import functools
+
+from .embeddings import _hs_step, _ns_step, _row_scale, generate_cbow
 from .tokenization import DefaultTokenizerFactory
-from .vocab import VocabCache, VocabConstructor, unigram_table
+from .vocab import VocabConstructor
 from .word2vec import WordVectors
 
 
@@ -57,6 +56,58 @@ class LabelsSource:
 
     def __len__(self):
         return len(self.labels)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _dm_ns_step(tables, docids, contexts, centers, negatives, lr):
+    """PV-DM negative-sampling step (reference DM.java): the predictor is
+    the MEAN of context word vectors AND the doc vector; gradients flow to
+    word rows, the doc row, and the output table."""
+
+    def loss_fn(t):
+        mask = (contexts >= 0).astype(t["syn0"].dtype)  # [B, W]
+        ctx = jnp.take(t["syn0"], jnp.maximum(contexts, 0), axis=0)
+        dvec = jnp.take(t["docs"], docids, axis=0)      # [B, D]
+        denom = mask.sum(-1, keepdims=True) + 1.0       # + doc slot
+        h = ((ctx * mask[..., None]).sum(1) + dvec) / denom
+        pos = jnp.take(t["syn1neg"], centers, axis=0)
+        neg = jnp.take(t["syn1neg"], negatives, axis=0)
+        return -(jax.nn.log_sigmoid(jnp.sum(h * pos, -1)).sum()
+                 + jax.nn.log_sigmoid(
+                     -jnp.einsum("bd,bkd->bk", h, neg)).sum())
+
+    loss, grads = jax.value_and_grad(loss_fn)(tables)
+    grads["syn0"] = _row_scale(grads["syn0"], contexts, contexts >= 0)
+    grads["docs"] = _row_scale(grads["docs"], docids)
+    syn1_idx = jnp.concatenate([centers[:, None], negatives], axis=1)
+    grads["syn1neg"] = _row_scale(grads["syn1neg"], syn1_idx)
+    new = {k: tables[k] - lr * grads[k] for k in tables}
+    return new, loss / docids.shape[0]
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _dm_hs_step(tables, docids, contexts, codes, points, lr):
+    """PV-DM hierarchical-softmax step (doc+context mean vs huffman path
+    of the center word)."""
+
+    def loss_fn(t):
+        mask = (contexts >= 0).astype(t["syn0"].dtype)
+        ctx = jnp.take(t["syn0"], jnp.maximum(contexts, 0), axis=0)
+        dvec = jnp.take(t["docs"], docids, axis=0)
+        denom = mask.sum(-1, keepdims=True) + 1.0
+        h = ((ctx * mask[..., None]).sum(1) + dvec) / denom
+        cmask = (codes >= 0).astype(h.dtype)
+        pts = jnp.take(t["syn1"], jnp.maximum(points, 0), axis=0)
+        score = jnp.einsum("bd,bld->bl", h, pts)
+        sign = 1.0 - 2.0 * jnp.maximum(codes, 0).astype(h.dtype)
+        return -(jax.nn.log_sigmoid(sign * score) * cmask).sum()
+
+    loss, grads = jax.value_and_grad(loss_fn)(tables)
+    grads["syn0"] = _row_scale(grads["syn0"], contexts, contexts >= 0)
+    grads["docs"] = _row_scale(grads["docs"], docids)
+    grads["syn1"] = _row_scale(grads["syn1"], points, codes >= 0)
+    new = {k: tables[k] - lr * grads[k] for k in tables}
+    return new, loss / docids.shape[0]
 
 
 @functools.partial(jax.jit, static_argnames=("steps",))
@@ -147,18 +198,17 @@ class ParagraphVectors(WordVectors):
             sampling=kw.get("sampling", 0.0),
             seed=kw.get("seed", 42))
         trainer = self._trainer
-        indexed = sentences_to_indices(docs, cache)
-        # One doc may die in indexing (all tokens sub-min-frequency): keep
-        # alignment doc-row ↔ label by re-indexing with empties preserved.
+        # Index once, preserving empty docs so doc-row ↔ label alignment
+        # survives docs whose tokens all fall under min frequency.
         indexed_all = []
         for tokens in docs:
             ids = [cache.index_of(t) for t in tokens]
             indexed_all.append(np.array([i for i in ids if i >= 0],
                                         dtype=np.int32))
+        indexed = [ids for ids in indexed_all if len(ids) > 1]
 
         epochs = kw.get("epochs", 1) * kw.get("iterations", 1)
-        if kw.get("train_word_vectors", True) and any(
-                len(ids) > 1 for ids in indexed):
+        if kw.get("train_word_vectors", True) and indexed:
             trainer.fit_sentences(indexed, epochs=epochs)
 
         self._fit_docs(indexed_all, epochs)
@@ -166,75 +216,111 @@ class ParagraphVectors(WordVectors):
         self._normed = None
         return self
 
+    def _gen_doc_pairs(self, indexed_docs, algo: str, window: int, rng):
+        """One epoch of training rows. DBOW: (doc, word) — every word
+        predicted from the doc vector. DM: (doc, context-window, center) —
+        CBOW rows tagged with their doc (reference DM.java consumes
+        label + context jointly)."""
+        if algo == "dbow":
+            dids, tgts = [], []
+            for d, ids in enumerate(indexed_docs):
+                dids.extend([d] * len(ids))
+                tgts.extend(ids.tolist())
+            return (np.asarray(dids, np.int32), None,
+                    np.asarray(tgts, np.int32))
+        if algo == "dm":
+            dids, ctx_rows, centers = [], [], []
+            for d, ids in enumerate(indexed_docs):
+                if len(ids) < 2:
+                    continue
+                ctxs, cents = generate_cbow([ids], window, rng)
+                dids.extend([d] * len(cents))
+                ctx_rows.append(ctxs)
+                centers.append(cents)
+            if not dids:
+                return (np.empty(0, np.int32), None, np.empty(0, np.int32))
+            return (np.asarray(dids, np.int32), np.vstack(ctx_rows),
+                    np.concatenate(centers).astype(np.int32))
+        raise ValueError(f"Unknown sequence algorithm {algo!r}")
+
     def _fit_docs(self, indexed_docs, epochs: int):
-        """DBOW (sequence algorithm 'dbow') or DM ('dm') passes over the
-        doc table, sharing the trainer's output tables."""
+        """DBOW or DM passes over the doc table, sharing the trainer's
+        output tables (syn1/syn1neg)."""
         kw = self._kw
         trainer = self._trainer
         rng = np.random.default_rng(kw.get("seed", 42) + 1)
         D = trainer.layer_size
-        n_docs = len(indexed_docs)
         key = jax.random.PRNGKey(kw.get("seed", 42) + 1)
-        doc_tab = jax.random.uniform(key, (n_docs, D), jnp.float32,
-                                     -0.5 / D, 0.5 / D)
+        doc_tab = jax.random.uniform(key, (len(indexed_docs), D),
+                                     jnp.float32, -0.5 / D, 0.5 / D)
         algo = kw.get("sequence_learning_algorithm", "dbow").lower()
-        window = trainer.window
+        B = trainer.batch_size
         lr0 = trainer.lr
-
-        steps_per_epoch = max(1, sum(len(ids) for ids in indexed_docs)
-                              // trainer.batch_size + 1)
-        total = max(1, epochs * steps_per_epoch)
+        total = None  # sized from the FIRST epoch's true row count
         step = 0
         for _ in range(epochs):
-            # (doc_id, target word) training pairs
-            if algo == "dbow":
-                # every word of the doc is predicted from the doc vector
-                dids, tgts = [], []
-                for d, ids in enumerate(indexed_docs):
-                    dids.extend([d] * len(ids))
-                    tgts.extend(ids.tolist())
-            elif algo == "dm":
-                # DM ~ skip-gram pairs with doc vector as extra predictor;
-                # here doc vector alone predicts context around each word
-                # then averages with the word (see divergence note below).
-                dids, tgts = [], []
-                for d, ids in enumerate(indexed_docs):
-                    c, ctx = generate_pairs([ids], window, rng)
-                    dids.extend([d] * len(ctx))
-                    tgts.extend(ctx.tolist())
-            else:
-                raise ValueError(f"Unknown sequence algorithm {algo!r}")
-            if not dids:
+            dids, ctxs, tgts = self._gen_doc_pairs(
+                indexed_docs, algo, trainer.window, rng)
+            n = len(dids)
+            if n == 0:
                 continue
-            dids = np.asarray(dids, np.int32)
-            tgts = np.asarray(tgts, np.int32)
-            order = rng.permutation(len(dids))
+            if total is None:
+                total = max(1, epochs * ((n + B - 1) // B))
+            order = rng.permutation(n)
             dids, tgts = dids[order], tgts[order]
-            B = trainer.batch_size
-            for start in range(0, len(dids), B):
-                end = min(start + B, len(dids))
-                lr = max(trainer.min_lr, lr0 * (1.0 - step / total))
+            if ctxs is not None:
+                ctxs = ctxs[order]
+            for start in range(0, n, B):
+                end = min(start + B, n)
+                lr = jnp.asarray(
+                    max(trainer.min_lr, lr0 * (1.0 - step / total)),
+                    jnp.float32)
                 dc = jnp.asarray(dids[start:end])
                 tg = jnp.asarray(tgts[start:end])
-                if trainer.use_hs:
-                    t = tgts[start:end]
-                    tables = {"syn0": doc_tab, "syn1": trainer.tables["syn1"]}
-                    tables, _ = _hs_step(
-                        tables, dc, tg, jnp.asarray(trainer._codes[t]),
-                        jnp.asarray(trainer._points[t]),
-                        jnp.asarray(lr, jnp.float32))
-                    doc_tab = tables["syn0"]
-                    trainer.tables["syn1"] = tables["syn1"]
-                if trainer.negative > 0:
-                    negs = rng.choice(trainer._unigram,
-                                      size=(end - start, trainer.negative))
-                    tables = {"syn0": doc_tab,
-                              "syn1neg": trainer.tables["syn1neg"]}
-                    tables, _ = _ns_step(
-                        tables, dc, tg, jnp.asarray(negs, jnp.int32),
-                        jnp.asarray(lr, jnp.float32))
-                    doc_tab = tables["syn0"]
-                    trainer.tables["syn1neg"] = tables["syn1neg"]
+                t_np = tgts[start:end]
+                if algo == "dbow":
+                    # DBOW == skip-gram with the doc table as predictor
+                    if trainer.use_hs:
+                        tables = {"syn0": doc_tab,
+                                  "syn1": trainer.tables["syn1"]}
+                        tables, _ = _hs_step(
+                            tables, dc, tg, jnp.asarray(trainer._codes[t_np]),
+                            jnp.asarray(trainer._points[t_np]), lr)
+                        doc_tab = tables["syn0"]
+                        trainer.tables["syn1"] = tables["syn1"]
+                    if trainer.negative > 0:
+                        negs = rng.choice(trainer._unigram,
+                                          size=(end - start, trainer.negative))
+                        tables = {"syn0": doc_tab,
+                                  "syn1neg": trainer.tables["syn1neg"]}
+                        tables, _ = _ns_step(
+                            tables, dc, tg, jnp.asarray(negs, jnp.int32), lr)
+                        doc_tab = tables["syn0"]
+                        trainer.tables["syn1neg"] = tables["syn1neg"]
+                else:  # dm
+                    cx = jnp.asarray(ctxs[start:end])
+                    if trainer.use_hs:
+                        tables = {"docs": doc_tab,
+                                  "syn0": trainer.tables["syn0"],
+                                  "syn1": trainer.tables["syn1"]}
+                        tables, _ = _dm_hs_step(
+                            tables, dc, cx, jnp.asarray(trainer._codes[t_np]),
+                            jnp.asarray(trainer._points[t_np]), lr)
+                        doc_tab = tables["docs"]
+                        trainer.tables["syn0"] = tables["syn0"]
+                        trainer.tables["syn1"] = tables["syn1"]
+                    if trainer.negative > 0:
+                        negs = rng.choice(trainer._unigram,
+                                          size=(end - start, trainer.negative))
+                        tables = {"docs": doc_tab,
+                                  "syn0": trainer.tables["syn0"],
+                                  "syn1neg": trainer.tables["syn1neg"]}
+                        tables, _ = _dm_ns_step(
+                            tables, dc, cx, tg, jnp.asarray(negs, jnp.int32),
+                            lr)
+                        doc_tab = tables["docs"]
+                        trainer.tables["syn0"] = tables["syn0"]
+                        trainer.tables["syn1neg"] = tables["syn1neg"]
                 step += 1
         self._doc_vectors = np.asarray(doc_tab)
 

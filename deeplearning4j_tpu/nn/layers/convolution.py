@@ -162,9 +162,10 @@ class Convolution1DLayer(ConvolutionLayer):
             self.n_in = input_type.size
         k, s = _pair(self.kernel_size)[0], _pair(self.stride)[0]
         p = _pair(self.padding)[0]
+        d = _pair(self.dilation)[0]
         t = input_type.timeseries_length
         out_t = None if t is None else conv_output_size(
-            t, k, s, p, self._mode())
+            t, k, s, p, self._mode(), d)
         return RecurrentType(size=self.n_out, timeseries_length=out_t)
 
     def forward(self, params, state, x, *, train=False, rng=None, mask=None):

@@ -93,6 +93,20 @@ class Layer:
         type. Reference: Layer.setNIn + getOutputType in nn/conf/layers."""
         return input_type
 
+    # ---- layerwise pretraining (reference Layer.fit / pretrain) ----------
+    def is_pretrainable(self) -> bool:
+        """True for unsupervised-pretrainable layers (AE/VAE/RBM family)."""
+        return False
+
+    def pretrain_loss(self, params, x, rng) -> Array:
+        raise NotImplementedError(
+            f"{type(self).__name__} has no pretraining objective")
+
+    def pretrain_grads(self, params, x, rng):
+        """(loss, grads) for one pretrain step — default: autodiff of
+        pretrain_loss; RBM overrides with CD-k statistics."""
+        return jax.value_and_grad(self.pretrain_loss)(params, x, rng)
+
     # ---- params/state ----------------------------------------------------
     def init_params(self, key: Array, dtype=jnp.float32) -> Params:
         return {}

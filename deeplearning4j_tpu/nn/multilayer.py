@@ -22,6 +22,7 @@ TPU-native redesign:
 """
 from __future__ import annotations
 
+import functools
 import logging
 import math
 from typing import Any, Callable, List, Optional, Sequence, Tuple
@@ -214,6 +215,70 @@ class MultiLayerNetwork:
             if isinstance(wrapped, AsyncDataSetIterator):
                 wrapped.shutdown()
         return self
+
+    # -------------------------------------------------------------- pretrain
+    def pretrain(self, data, *, epochs: int = 1, batch_size: int = 32
+                 ) -> "MultiLayerNetwork":
+        """Greedy layerwise unsupervised pretraining (reference
+        MultiLayerNetwork.pretrain(DataSetIterator):1036): for each
+        pretrainable layer in order, feed the frozen prefix's activations
+        and step that layer's own pretrain objective with its own updater.
+        Labels in `data` are ignored (features-only, like the reference)."""
+        self._check_init()
+        if isinstance(data, np.ndarray):  # features-only array is fine here
+            data = DataSet(data, np.zeros((data.shape[0], 1), np.float32))
+        for i, layer in enumerate(self.layers):
+            if not layer.is_pretrainable():
+                continue
+            prefix = jax.jit(functools.partial(self._prefix_activations, i))
+            step = self._pretrain_step_fn(i, layer)
+            params_i = self.params_tree[i]
+            opt_i = layer.updater.init(params_i)
+            it_count = jnp.asarray(0, jnp.int32)
+            rng = self._rng
+            last = None
+            for _ in range(epochs):
+                it = as_iterator(data, None, batch_size)
+                for ds in it:
+                    x = prefix(self.params_tree, self.state_tree,
+                               self._cast_features(ds.features))
+                    params_i, opt_i, it_count, rng, last = step(
+                        params_i, opt_i, it_count, rng, x)
+            self._rng = rng
+            if last is not None:
+                self.score_value = last
+            self.params_tree = tuple(
+                params_i if j == i else p
+                for j, p in enumerate(self.params_tree))
+        return self
+
+    def _prefix_activations(self, i, params, state, x):
+        """Inference-mode activations feeding layer i (its preprocessor
+        included)."""
+        a = x
+        for j in range(i):
+            p = self.conf.preprocessor(j)
+            if p is not None:
+                a = p(a)
+            a, _ = self.layers[j].forward(params[j], state[j], a,
+                                          train=False, rng=None, mask=None)
+        p = self.conf.preprocessor(i)
+        if p is not None:
+            a = p(a)
+        return a
+
+    def _pretrain_step_fn(self, i, layer):
+        def step(params_i, opt_i, iteration, rng, x):
+            rng, sub = jax.random.split(rng)
+            loss, grads = layer.pretrain_grads(params_i, x, sub)
+            g = normalize_layer_gradients(
+                grads, layer.gradient_normalization,
+                layer.gradient_normalization_threshold)
+            updates, opt2 = layer.updater.update(g, opt_i, iteration)
+            new_p = jax.tree_util.tree_map(
+                lambda p, u: p - u.astype(p.dtype), params_i, updates)
+            return new_p, opt2, iteration + 1, rng, loss
+        return jax.jit(step)
 
     def _fit_batch(self, ds: DataSet, do_step=None):
         do_step = do_step or self._do_step

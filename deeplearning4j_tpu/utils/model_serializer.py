@@ -150,3 +150,35 @@ def restore_normalizer(path: str):
         if NORMALIZER_ENTRY not in zf.namelist():
             return None
         return serde.from_json(zf.read(NORMALIZER_ENTRY).decode("utf-8"))
+
+
+class ModelSerializer:
+    """Reference-named facade (util/ModelSerializer.java API surface) over
+    the module-level functions; the multi-host runner and user code use
+    these names."""
+
+    writeModel = write_model = staticmethod(save_model)
+    restoreModel = staticmethod(restore_model)
+
+    @staticmethod
+    def restore_multi_layer_network(path: str, load_updater: bool = True):
+        from ..nn.multilayer import MultiLayerNetwork
+        model = restore_model(path, load_updater)
+        if not isinstance(model, MultiLayerNetwork):
+            raise ValueError(f"{path} holds a "
+                             f"{type(model).__name__}, not a "
+                             "MultiLayerNetwork")
+        return model
+
+    @staticmethod
+    def restore_computation_graph(path: str, load_updater: bool = True):
+        from ..nn.graph.graph import ComputationGraph
+        model = restore_model(path, load_updater)
+        if not isinstance(model, ComputationGraph):
+            raise ValueError(f"{path} holds a "
+                             f"{type(model).__name__}, not a "
+                             "ComputationGraph")
+        return model
+
+    restoreMultiLayerNetwork = restore_multi_layer_network
+    restoreComputationGraph = restore_computation_graph
