@@ -32,8 +32,17 @@ from .nn.updaters import (Adam, AdaDelta, AdaGrad, AdaMax, GradientNormalization
                           Nesterovs, NoOp, RmsProp, Sgd)
 from .nn.weights import Distribution, WeightInit
 from .data.dataset import DataSet, MultiDataSet
-from .data.iterators import (AsyncDataSetIterator, DataSetIterator,
-                             ExistingDataSetIterator, ListDataSetIterator)
+from .data.fetchers import (IrisDataSetIterator, MnistDataFetcher,
+                            MnistDataSetIterator)
+from .data.iterators import (AsyncDataSetIterator, AsyncMultiDataSetIterator,
+                             DataSetIterator, ExistingDataSetIterator,
+                             ListDataSetIterator)
+from .data.normalizers import (ImagePreProcessingScaler,
+                               NormalizerMinMaxScaler, NormalizerStandardize)
+from .data.records import (CSVRecordReader, CSVSequenceRecordReader,
+                           ListStringRecordReader, RecordReader,
+                           RecordReaderDataSetIterator,
+                           SequenceRecordReaderDataSetIterator)
 from .eval.evaluation import Evaluation, EvaluationBinary, RegressionEvaluation
 from .nn.transfer_learning import (FineTuneConfiguration, TransferLearning,
                                    TransferLearningHelper)

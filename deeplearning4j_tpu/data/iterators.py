@@ -224,6 +224,21 @@ class AsyncDataSetIterator(DataSetIterator):
         self._stop_thread()
 
 
+class AsyncMultiDataSetIterator(AsyncDataSetIterator):
+    """Background prefetch over MultiDataSet streams (reference
+    datasets/iterator/AsyncMultiDataSetIterator.java) — same bounded-queue
+    machinery; ComputationGraph.fit wraps with this (reference
+    ComputationGraph.java:867)."""
+
+    def __init__(self, base, queue_size: int = 8):
+        # `base` may be any (re-)iterable of MultiDataSets, incl. a list.
+        super().__init__(base, queue_size)
+
+    def batch_size(self):
+        return self._base.batch_size() if hasattr(self._base, "batch_size") \
+            else None
+
+
 class IteratorDataSetIterator(DataSetIterator):
     """Re-batch a stream of DataSets to a fixed minibatch size (reference
     IteratorDataSetIterator, used by the Spark worker loop)."""

@@ -138,23 +138,40 @@ class KerasModelImport:
             # ends in an output layer as MultiLayerNetwork requires.
             terminal_act = None
             fold_idx = None
+            tail_head = None
             if 0 <= last_param_idx < len(layer_cfgs) - 1:
                 trailing = [(i, lc) for i, lc in
                             enumerate(layer_cfgs[last_param_idx + 1:],
                                       last_param_idx + 1)
                             if lc["class_name"] == "Activation"]
                 term_cfg = layer_cfgs[last_param_idx]
-                # Fold only when the param layer itself is LINEAR — folding
-                # over Dense(relu)→Activation(softmax) would silently drop
-                # the relu.
                 if len(trailing) == 1 and \
-                        trailing[0][0] == len(layer_cfgs) - 1 and \
-                        term_cfg.get("config", {}).get(
-                            "activation", "linear") == "linear":
+                        trailing[0][0] == len(layer_cfgs) - 1:
                     from .layer_mappers import map_activation
-                    fold_idx = trailing[0][0]
-                    terminal_act = map_activation(
-                        trailing[0][1]["config"].get("activation", "linear"))
+                    if term_cfg.get("config", {}).get(
+                            "activation", "linear") == "linear":
+                        # Linear param layer: fold the activation INTO the
+                        # loss head.
+                        fold_idx = trailing[0][0]
+                        terminal_act = map_activation(
+                            trailing[0][1]["config"].get("activation",
+                                                         "linear"))
+                    else:
+                        # Dense(relu) → Activation(softmax): folding would
+                        # drop the relu, so the Activation itself becomes
+                        # the LossLayer head and the Dense stays plain.
+                        last_param_idx = -1  # no param layer is terminal
+                        fold_idx = trailing[0][0]
+                        act = map_activation(
+                            trailing[0][1]["config"].get("activation",
+                                                         "linear"))
+                        from ..nn.layers.core import LossLayer
+                        from .layer_mappers import _LOSS_BY_ACTIVATION
+                        tail_head = LossLayer(
+                            name=trailing[0][1]["config"].get("name"),
+                            activation=act,
+                            loss=loss or _LOSS_BY_ACTIVATION.get(act,
+                                                                 "mse"))
             for i, lc in enumerate(layer_cfgs):
                 if i == fold_idx:
                     continue  # folded into the terminal loss head
@@ -176,6 +193,8 @@ class KerasModelImport:
                         "vertex; use import_keras_model_and_weights (graph)")
                 if not m.skip:
                     mapped_layers.append((m, lc["config"].get("name", "")))
+            if tail_head is not None:
+                mapped_layers.append((Mapped(tail_head), ""))
             if input_type is None:
                 raise InvalidKerasConfigurationException(
                     "Could not find an input shape (no batch_shape on any "

@@ -25,7 +25,8 @@ import numpy as np
 
 import functools
 
-from .embeddings import _hs_step, _ns_step, _row_scale, generate_cbow
+from .embeddings import (_MAX_EXP, _hs_step, _ns_step, _row_scale,
+                         generate_cbow)
 from .tokenization import DefaultTokenizerFactory
 from .vocab import VocabConstructor
 from .word2vec import WordVectors
@@ -99,8 +100,11 @@ def _dm_hs_step(tables, docids, contexts, codes, points, lr):
         cmask = (codes >= 0).astype(h.dtype)
         pts = jnp.take(t["syn1"], jnp.maximum(points, 0), axis=0)
         score = jnp.einsum("bd,bld->bl", h, pts)
+        # word2vec.c MAX_EXP skip-window, identical to embeddings._hs_step
+        in_win = jax.lax.stop_gradient(
+            (jnp.abs(score) < _MAX_EXP).astype(h.dtype))
         sign = 1.0 - 2.0 * jnp.maximum(codes, 0).astype(h.dtype)
-        return -(jax.nn.log_sigmoid(sign * score) * cmask).sum()
+        return -(jax.nn.log_sigmoid(sign * score) * cmask * in_win).sum()
 
     loss, grads = jax.value_and_grad(loss_fn)(tables)
     grads["syn0"] = _row_scale(grads["syn0"], contexts, contexts >= 0)

@@ -31,6 +31,28 @@ from ..updaters import normalize_layer_gradients
 Array = jax.Array
 
 
+class _SlicingMultiIterator:
+    """Re-iterable minibatch views over one MultiDataSet (host numpy
+    slices — cheap views feeding the async prefetch thread)."""
+
+    def __init__(self, mds: MultiDataSet, batch_size: int):
+        self._mds = mds
+        self._batch = int(batch_size)
+
+    def __iter__(self):
+        mds, B = self._mds, self._batch
+        n = mds.num_examples()
+        for start in range(0, n, B):
+            sl = slice(start, min(start + B, n))
+            yield MultiDataSet(
+                [f[sl] for f in mds.features],
+                [l[sl] for l in mds.labels],
+                None if mds.features_masks is None else
+                [None if m is None else m[sl] for m in mds.features_masks],
+                None if mds.labels_masks is None else
+                [None if m is None else m[sl] for m in mds.labels_masks])
+
+
 class ComputationGraph:
     def __init__(self, conf: ComputationGraphConfiguration):
         self.conf = conf
@@ -237,10 +259,14 @@ class ComputationGraph:
 
     # ------------------------------------------------------------------- fit
     def fit(self, data, labels=None, *, epochs: int = 1,
-            batch_size: int = 32, step_fn=None) -> "ComputationGraph":
+            batch_size: int = 32, step_fn=None, use_async: bool = True,
+            async_queue_size: int = 8) -> "ComputationGraph":
         """Train (reference fit(MultiDataSetIterator):867). Accepts a
         MultiDataSet, DataSet, (features, labels) arrays, or an iterator of
-        either. `step_fn` lets ParallelWrapper substitute a sharded step."""
+        either. `step_fn` lets ParallelWrapper substitute a sharded step.
+        Batches prefetch on a background thread (the reference wraps with
+        AsyncMultiDataSetIterator at :867) unless use_async=False."""
+        from ...data.iterators import AsyncMultiDataSetIterator
         self._check_init()
         step = step_fn or self.fit_batch
         if self.conf.backprop_type == BackpropType.TRUNCATED_BPTT:
@@ -253,31 +279,23 @@ class ComputationGraph:
             if epochs > 1 and not hasattr(iterator, "reset"):
                 # Plain generator: materialize so later epochs see data.
                 iterator = list(iterator)
+        else:
+            mds = self._coerce(data, labels)
+            iterator = _SlicingMultiIterator(mds, batch_size)
+        async_ok = getattr(iterator, "async_supported", lambda: True)()
+        wrapped = AsyncMultiDataSetIterator(iterator, async_queue_size) \
+            if (use_async and async_ok) else iterator
+        try:
             for _ in range(epochs):
-                for ds in iterator:
+                for ds in wrapped:
                     step(self._coerce(ds))
                 self.epoch += 1
                 for lst in self.listeners:
                     if hasattr(lst, "on_epoch_end"):
                         lst.on_epoch_end(self, self.epoch)
-            return self
-        mds = self._coerce(data, labels)
-        n = mds.num_examples()
-        for _ in range(epochs):
-            for start in range(0, n, batch_size):
-                sl = slice(start, min(start + batch_size, n))
-                batch = MultiDataSet(
-                    [f[sl] for f in mds.features],
-                    [l[sl] for l in mds.labels],
-                    None if mds.features_masks is None else
-                    [None if m is None else m[sl] for m in mds.features_masks],
-                    None if mds.labels_masks is None else
-                    [None if m is None else m[sl] for m in mds.labels_masks])
-                step(batch)
-            self.epoch += 1
-            for lst in self.listeners:
-                if hasattr(lst, "on_epoch_end"):
-                    lst.on_epoch_end(self, self.epoch)
+        finally:
+            if wrapped is not iterator:
+                wrapped.shutdown()
         return self
 
     def fit_batch(self, mds: MultiDataSet):

@@ -228,8 +228,8 @@ class MultiLayerNetwork:
         if isinstance(data, np.ndarray):  # features-only array is fine here
             data = DataSet(data, np.zeros((data.shape[0], 1), np.float32))
         for i, layer in enumerate(self.layers):
-            if not layer.is_pretrainable():
-                continue
+            if not layer.is_pretrainable() or layer.frozen:
+                continue  # frozen: transfer-learning protection, like fit()
             prefix = jax.jit(functools.partial(self._prefix_activations, i))
             step = self._pretrain_step_fn(i, layer)
             params_i = self.params_tree[i]

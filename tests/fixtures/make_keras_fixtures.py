@@ -102,6 +102,15 @@ act_tail = keras.Sequential([
 act_tail.compile(loss="categorical_crossentropy", optimizer="adam")
 save(act_tail, "act_tail", rng.standard_normal((5, 8)).astype(np.float32))
 
+# 7. Non-linear terminal Dense followed by an Activation (no fold legal)
+relu_tail = keras.Sequential([
+    keras.Input((8,)),
+    layers.Dense(3, activation="relu", name="scores"),
+    layers.Activation("softmax", name="sm"),
+])
+relu_tail.compile(loss="categorical_crossentropy", optimizer="adam")
+save(relu_tail, "relu_tail", rng.standard_normal((5, 8)).astype(np.float32))
+
 np.savez(os.path.join(OUT, "expected.npz"), **expected)
 print("Wrote fixtures to", OUT)
 for k in sorted(expected):

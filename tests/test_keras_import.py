@@ -78,6 +78,21 @@ class TestSequentialImport:
         net.fit(x, y, epochs=20, batch_size=len(x))
         assert net.score(x=x, y=y) < before
 
+    def test_nonlinear_dense_activation_tail_stays_trainable(self, expected):
+        """Dense(relu) → Activation(softmax): no fold (would drop the
+        relu); the Activation becomes a LossLayer head instead, keeping
+        both parity and trainability."""
+        net = KerasModelImport.import_keras_sequential_model_and_weights(
+            _h5("relu_tail"))
+        out = net.output(expected["relu_tail_x"])
+        np.testing.assert_allclose(out, expected["relu_tail_y"], rtol=1e-4,
+                                   atol=1e-5)
+        x = expected["relu_tail_x"]
+        y = np.eye(3, dtype=np.float32)[np.arange(len(x)) % 3]
+        before = net.score(x=x, y=y)
+        net.fit(x, y, epochs=25, batch_size=len(x))
+        assert net.score(x=x, y=y) < before
+
     def test_keras2_style_sequential_without_input_layer(self, tmp_path,
                                                          expected):
         """Keras 2.x h5 (no InputLayer; batch_input_shape on the first
