@@ -44,6 +44,7 @@ from .data.records import (CSVRecordReader, CSVSequenceRecordReader,
                            RecordReaderDataSetIterator,
                            SequenceRecordReaderDataSetIterator)
 from .eval.evaluation import Evaluation, EvaluationBinary, RegressionEvaluation
+from .eval.roc import ROC, ROCBinary, ROCMultiClass
 from .nn.transfer_learning import (FineTuneConfiguration, TransferLearning,
                                    TransferLearningHelper)
 from .optimize.listeners import (CheckpointListener,

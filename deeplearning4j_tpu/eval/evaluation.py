@@ -40,9 +40,12 @@ class Evaluation:
     """Classification metrics accumulator (reference eval/Evaluation.java)."""
 
     def __init__(self, n_classes: Optional[int] = None,
-                 label_names: Optional[List[str]] = None):
+                 label_names: Optional[List[str]] = None, top_n: int = 1):
         self.n_classes = n_classes
         self.label_names = label_names
+        self.top_n = int(top_n)
+        self.top_n_correct = 0
+        self.top_n_total = 0
         self.confusion: Optional[np.ndarray] = None
         if n_classes:
             self.confusion = np.zeros((n_classes, n_classes), np.int64)
@@ -66,6 +69,17 @@ class Evaluation:
         if keep is not None:
             t, p = t[keep], p[keep]
         np.add.at(self.confusion, (t, p), 1)
+        # Top-N accuracy (reference Evaluation topN): needs probability
+        # rows; rank-1 integer predictions can only support top-1.
+        preds = np.asarray(predictions)
+        if self.top_n > 1 and preds.ndim >= 2:
+            flat = preds.reshape(-1, preds.shape[-1])
+            if keep is not None:
+                flat = flat[keep]
+            k = min(self.top_n, flat.shape[-1])
+            topk = np.argpartition(-flat, k - 1, axis=-1)[:, :k]
+            self.top_n_correct += int((topk == t[:, None]).any(-1).sum())
+            self.top_n_total += t.size
 
     # ----------------------------------------------------------- metrics
     def num_examples(self) -> int:
@@ -105,6 +119,19 @@ class Evaluation:
         p, r = self.precision(cls), self.recall(cls)
         return 2 * p * r / (p + r) if (p + r) else 0.0
 
+    def top_n_accuracy(self) -> float:
+        """Reference Evaluation.topNAccuracy(): fraction of examples whose
+        true class was among the top_n highest-probability predictions."""
+        if self.top_n <= 1:
+            return self.accuracy()
+        return self.top_n_correct / self.top_n_total \
+            if self.top_n_total else 0.0
+
+    def label_name(self, cls: int) -> str:
+        if self.label_names is not None and cls < len(self.label_names):
+            return self.label_names[cls]
+        return str(cls)
+
     def merge(self, other: "Evaluation") -> "Evaluation":
         """Accumulator merge (reference IEvaluation.merge; used by the
         data-parallel evaluator)."""
@@ -113,18 +140,34 @@ class Evaluation:
         self._ensure(other.confusion.shape[0])
         self.confusion[:other.confusion.shape[0], :other.confusion.shape[1]] += \
             other.confusion
+        self.top_n_correct += other.top_n_correct
+        self.top_n_total += other.top_n_total
+        if self.label_names is None:
+            self.label_names = other.label_names
         return self
 
     def stats(self) -> str:
+        """Reference Evaluation.stats(): overall metrics + per-class
+        label-named precision/recall/f1 rows + confusion matrix."""
         lines = [
             f"# examples: {self.num_examples()}",
             f"Accuracy:  {self.accuracy():.4f}",
             f"Precision: {self.precision():.4f}",
             f"Recall:    {self.recall():.4f}",
             f"F1 Score:  {self.f1():.4f}",
-            "Confusion matrix (rows=actual, cols=predicted):",
-            str(self.confusion),
         ]
+        if self.top_n > 1:
+            lines.append(f"Top-{self.top_n} Accuracy: "
+                         f"{self.top_n_accuracy():.4f}")
+        if self.n_classes:
+            lines.append("Per-class (label: precision, recall, f1, count):")
+            for c in range(self.n_classes):
+                cnt = int(self.confusion[c, :].sum())
+                lines.append(
+                    f"  {self.label_name(c)}: {self.precision(c):.4f}, "
+                    f"{self.recall(c):.4f}, {self.f1(c):.4f}, {cnt}")
+        lines += ["Confusion matrix (rows=actual, cols=predicted):",
+                  str(self.confusion)]
         return "\n".join(lines)
 
 

@@ -1,0 +1,101 @@
+"""Static training report from a StatsStorage.
+
+Reference parity: the role of deeplearning4j-play's train UI module
+(PlayUIServer score chart, model tab, system tab) — rendered as a
+self-contained static HTML file (inline SVG, zero JS dependencies) plus
+a machine-readable JSON export. A live server adds nothing on a TPU pod
+where runs are batch jobs; a file artifact is greppable and archivable.
+"""
+from __future__ import annotations
+
+import html
+import json
+from typing import Any, Dict, List
+
+from .stats import StatsStorage
+
+
+def export_json(storage: StatsStorage, session_id: str = None) -> str:
+    """All updates for one (or the only) session as a JSON document."""
+    sessions = storage.list_session_ids()
+    if not sessions:
+        raise ValueError("Storage holds no sessions")
+    sid = session_id or sessions[0]
+    return json.dumps({"session": sid,
+                       "updates": storage.get_updates(sid)}, indent=2)
+
+
+def _svg_polyline(xs: List[float], ys: List[float], width=640, height=240,
+                  pad=36) -> str:
+    if not xs:
+        return "<svg></svg>"
+    x0, x1 = min(xs), max(xs) or 1
+    y0, y1 = min(ys), max(ys)
+    if y1 == y0:
+        y1 = y0 + 1
+    sx = lambda x: pad + (x - x0) / max(x1 - x0, 1e-12) * (width - 2 * pad)
+    sy = lambda y: height - pad - (y - y0) / (y1 - y0) * (height - 2 * pad)
+    pts = " ".join(f"{sx(x):.1f},{sy(y):.1f}" for x, y in zip(xs, ys))
+    return (
+        f'<svg viewBox="0 0 {width} {height}" width="{width}" '
+        f'height="{height}" xmlns="http://www.w3.org/2000/svg">'
+        f'<rect width="{width}" height="{height}" fill="#fafafa"/>'
+        f'<text x="{pad}" y="16" font-size="11">score (min '
+        f'{y0:.4g}, max {y1:.4g})</text>'
+        f'<polyline fill="none" stroke="#2266cc" stroke-width="1.5" '
+        f'points="{pts}"/></svg>')
+
+
+def render_html_report(storage: StatsStorage, path: str,
+                       session_id: str = None) -> str:
+    """Write a browsable report; returns the path (reference: the train
+    module's overview page)."""
+    sessions = storage.list_session_ids()
+    if not sessions:
+        raise ValueError("Storage holds no sessions")
+    sid = session_id or sessions[0]
+    updates = [u for u in storage.get_updates(sid) if "epoch_end" not in u]
+    iters = [u["iteration"] for u in updates if u.get("score") is not None]
+    scores = [u["score"] for u in updates if u.get("score") is not None]
+    times = [u.get("iteration_ms") for u in updates
+             if u.get("iteration_ms") is not None]
+    last = updates[-1] if updates else {}
+
+    rows = []
+    if times:
+        import statistics
+        rows.append(("mean iteration (ms)",
+                     f"{statistics.fmean(times):.2f}"))
+    if scores:
+        rows.append(("final score", f"{scores[-1]:.6g}"))
+        rows.append(("best score", f"{min(scores):.6g}"))
+    rows.append(("iterations", str(iters[-1] if iters else 0)))
+    if "host_max_rss_mb" in last:
+        rows.append(("host max RSS (MB)",
+                     f"{last['host_max_rss_mb']:.1f}"))
+    mm = last.get("param_mean_magnitudes") or {}
+    table = "".join(f"<tr><td>{html.escape(k)}</td><td>{v}</td></tr>"
+                    for k, v in rows)
+    mm_table = "".join(
+        f"<tr><td>{html.escape(k)}</td><td>{v:.6g}</td></tr>"
+        for k, v in sorted(mm.items()))
+    doc = f"""<!doctype html>
+<html><head><meta charset="utf-8">
+<title>Training report — {html.escape(sid)}</title>
+<style>body{{font:13px sans-serif;margin:2em}}td{{padding:2px 10px;
+border-bottom:1px solid #eee}}h2{{margin-top:1.4em}}</style></head>
+<body>
+<h1>Training report</h1>
+<p>session <code>{html.escape(sid)}</code>, {len(updates)} updates</p>
+<h2>Score</h2>
+{_svg_polyline([float(i) for i in iters], [float(s) for s in scores])}
+<h2>Summary</h2><table>{table}</table>
+<h2>Parameter mean magnitudes (last iteration)</h2>
+<table>{mm_table}</table>
+<script type="application/json" id="stats-data">
+{export_json(storage, sid)}
+</script>
+</body></html>"""
+    with open(path, "w") as f:
+        f.write(doc)
+    return path

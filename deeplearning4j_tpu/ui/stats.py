@@ -1,0 +1,206 @@
+"""Training observability: StatsListener → StatsStorage pipeline.
+
+Reference parity: deeplearning4j-ui-model's BaseStatsListener
+(ui/stats/BaseStatsListener.java:280+ — per-iteration score, timings,
+memory, param/gradient/update histograms and mean magnitudes) routed
+through the StatsStorageRouter contract (deeplearning4j-core
+api/storage/StatsStorage.java) into InMemoryStatsStorage /
+FileStatsStorage backends (ui/storage/). The SBE binary wire format and
+the Play UI server are replaced by plain JSON records and a static HTML
+report (ui/report.py) — the storage API surface is what downstream code
+programs against, and that is preserved.
+"""
+from __future__ import annotations
+
+import json
+import os
+import resource
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..optimize.listeners import IterationListener
+
+
+# ---------------------------------------------------------------------------
+# Storage (reference api/storage/StatsStorage.java)
+# ---------------------------------------------------------------------------
+class StatsStorage:
+    """SPI: session-keyed append-only update records."""
+
+    def put_update(self, session_id: str, record: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def list_session_ids(self) -> List[str]:
+        raise NotImplementedError
+
+    def get_updates(self, session_id: str) -> List[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def get_latest_update(self, session_id: str) -> Optional[Dict[str, Any]]:
+        ups = self.get_updates(session_id)
+        return ups[-1] if ups else None
+
+
+class InMemoryStatsStorage(StatsStorage):
+    """Reference ui/storage/InMemoryStatsStorage.java."""
+
+    def __init__(self):
+        self._updates: Dict[str, List[Dict[str, Any]]] = {}
+
+    def put_update(self, session_id, record):
+        self._updates.setdefault(session_id, []).append(record)
+
+    def list_session_ids(self):
+        return list(self._updates)
+
+    def get_updates(self, session_id):
+        return list(self._updates.get(session_id, []))
+
+
+class FileStatsStorage(StatsStorage):
+    """JSON-lines file persistence (reference ui/storage/FileStatsStorage
+    over MapDB; a flat JSONL file is the TPU-era equivalent — trivially
+    greppable and survives restarts)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+
+    def put_update(self, session_id, record):
+        with open(self.path, "a") as f:
+            f.write(json.dumps({"session": session_id, **record}) + "\n")
+
+    def _read(self) -> List[Dict[str, Any]]:
+        if not os.path.exists(self.path):
+            return []
+        with open(self.path) as f:
+            return [json.loads(line) for line in f if line.strip()]
+
+    def list_session_ids(self):
+        seen = []
+        for rec in self._read():
+            if rec["session"] not in seen:
+                seen.append(rec["session"])
+        return seen
+
+    def get_updates(self, session_id):
+        return [{k: v for k, v in rec.items() if k != "session"}
+                for rec in self._read() if rec["session"] == session_id]
+
+
+# ---------------------------------------------------------------------------
+# Listener (reference ui/stats/BaseStatsListener.java)
+# ---------------------------------------------------------------------------
+class StatsUpdateConfiguration:
+    """What to collect per update (reference
+    DefaultStatsUpdateConfiguration builder)."""
+
+    def __init__(self, *, collect_score: bool = True,
+                 collect_timings: bool = True,
+                 collect_memory: bool = True,
+                 collect_histograms: bool = False,
+                 histogram_bins: int = 20,
+                 collect_mean_magnitudes: bool = True,
+                 collect_updates: bool = False):
+        self.collect_score = collect_score
+        self.collect_timings = collect_timings
+        self.collect_memory = collect_memory
+        self.collect_histograms = collect_histograms
+        self.histogram_bins = int(histogram_bins)
+        self.collect_mean_magnitudes = collect_mean_magnitudes
+        self.collect_updates = collect_updates
+
+
+def _named_params(model):
+    """Yield (name, np.ndarray) over the model's parameter tree."""
+    tree = model.params_tree
+    if isinstance(tree, dict):  # ComputationGraph: name-keyed
+        for node, params in tree.items():
+            for pname, arr in params.items():
+                yield f"{node}/{pname}", np.asarray(arr)
+    else:  # MultiLayerNetwork: indexed tuple
+        for i, params in enumerate(tree):
+            for pname, arr in params.items():
+                yield f"layer{i}/{pname}", np.asarray(arr)
+
+
+class StatsListener(IterationListener):
+    """Collects per-iteration training statistics into a StatsStorage
+    (reference StatsListener/BaseStatsListener). Attach with
+    net.add_listener(StatsListener(storage))."""
+
+    def __init__(self, storage: StatsStorage, frequency: int = 1,
+                 session_id: Optional[str] = None,
+                 config: Optional[StatsUpdateConfiguration] = None):
+        self.storage = storage
+        self.frequency = max(1, int(frequency))
+        self.session_id = session_id or f"session-{int(time.time() * 1000)}"
+        self.config = config or StatsUpdateConfiguration()
+        self._last_time: Optional[float] = None
+        self._prev_params: Optional[Dict[str, np.ndarray]] = None
+
+    def _histogram(self, arr: np.ndarray):
+        counts, edges = np.histogram(arr, bins=self.config.histogram_bins)
+        return {"counts": counts.tolist(),
+                "min": float(edges[0]), "max": float(edges[-1])}
+
+    def iteration_done(self, model, iteration: int) -> None:
+        now = time.time()
+        duration_ms = None if self._last_time is None \
+            else (now - self._last_time) * 1000.0
+        self._last_time = now
+        if iteration % self.frequency != 0:
+            return
+        cfg = self.config
+        rec: Dict[str, Any] = {"iteration": int(iteration),
+                               "timestamp": now}
+        if cfg.collect_score:
+            rec["score"] = float(model.score_value) \
+                if model.score_value is not None else None
+        if cfg.collect_timings and duration_ms is not None:
+            rec["iteration_ms"] = duration_ms
+        if cfg.collect_memory:
+            # ru_maxrss: KiB on linux — host-side RSS (the JVM-heap analog)
+            rec["host_max_rss_mb"] = \
+                resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+            try:
+                import jax
+                stats = jax.local_devices()[0].memory_stats()
+                if stats:
+                    rec["device_bytes_in_use"] = stats.get("bytes_in_use")
+            except Exception:
+                pass
+        if cfg.collect_mean_magnitudes or cfg.collect_histograms or \
+                cfg.collect_updates:
+            mm: Dict[str, float] = {}
+            hists: Dict[str, Any] = {}
+            upd_mm: Dict[str, float] = {}
+            new_prev: Dict[str, np.ndarray] = {}
+            for name, arr in _named_params(model):
+                if cfg.collect_mean_magnitudes:
+                    mm[name] = float(np.mean(np.abs(arr)))
+                if cfg.collect_histograms:
+                    hists[name] = self._histogram(arr)
+                if cfg.collect_updates:
+                    if self._prev_params is not None and \
+                            name in self._prev_params:
+                        upd_mm[name] = float(np.mean(np.abs(
+                            arr - self._prev_params[name])))
+                    new_prev[name] = arr.copy()
+            if cfg.collect_mean_magnitudes:
+                rec["param_mean_magnitudes"] = mm
+            if cfg.collect_histograms:
+                rec["param_histograms"] = hists
+            if cfg.collect_updates:
+                self._prev_params = new_prev
+                if upd_mm:
+                    rec["update_mean_magnitudes"] = upd_mm
+        self.storage.put_update(self.session_id, rec)
+
+    def on_epoch_end(self, model, epoch: int) -> None:
+        self.storage.put_update(self.session_id,
+                                {"epoch_end": int(epoch),
+                                 "iteration": int(model.iteration),
+                                 "timestamp": time.time()})

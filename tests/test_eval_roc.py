@@ -1,0 +1,180 @@
+"""ROC family + top-N accuracy tests (reference EvalTest / ROCTest
+strategy: hand-computed fixture AUCs must match exactly)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (ROC, Evaluation, ROCBinary, ROCMultiClass)
+
+
+class TestROCExact:
+    def test_hand_computed_auc(self):
+        """4 points: scores .1/.4/.35/.8, labels 0/0/1/1 — the classic
+        sklearn doc fixture; AUC = 0.75 by direct trapezoid computation."""
+        roc = ROC()
+        roc.eval(np.array([0, 0, 1, 1.0]), np.array([0.1, 0.4, 0.35, 0.8]))
+        assert roc.calculate_auc() == pytest.approx(0.75)
+
+    def test_perfect_and_worst_separation(self):
+        roc = ROC()
+        roc.eval(np.array([0, 0, 1, 1.0]), np.array([0.1, 0.2, 0.8, 0.9]))
+        assert roc.calculate_auc() == pytest.approx(1.0)
+        inv = ROC()
+        inv.eval(np.array([1, 1, 0, 0.0]), np.array([0.1, 0.2, 0.8, 0.9]))
+        assert inv.calculate_auc() == pytest.approx(0.0)
+
+    def test_random_scores_auc_half(self):
+        rng = np.random.default_rng(0)
+        y = rng.integers(0, 2, 4000).astype(float)
+        p = rng.random(4000)
+        roc = ROC()
+        roc.eval(y, p)
+        assert roc.calculate_auc() == pytest.approx(0.5, abs=0.03)
+
+    def test_rank1_labels_with_softmax_predictions(self):
+        """The common pairing: class-index labels + [N,2] softmax probs
+        (regression test: used to flatten probs to 2N scores and crash)."""
+        roc = ROC()
+        y = np.array([0, 0, 1, 1])
+        p = np.array([[0.9, 0.1], [0.6, 0.4], [0.65, 0.35], [0.2, 0.8]],
+                     np.float32)
+        roc.eval(y, p)
+        assert roc.calculate_auc() == pytest.approx(0.75)
+        stepped = ROC(threshold_steps=100)
+        stepped.eval(y, p)
+        assert np.isfinite(stepped.calculate_auc())
+
+    def test_thresholded_auprc_streaming_memory(self):
+        """Thresholded AUPRC comes from cumulative bin counts, close to
+        exact."""
+        rng = np.random.default_rng(7)
+        y = (rng.random(5000) < 0.3).astype(float)
+        p = np.clip(0.5 * y + rng.normal(0.3, 0.2, 5000), 0, 1)
+        exact = ROC(); exact.eval(y, p)
+        stepped = ROC(threshold_steps=500); stepped.eval(y, p)
+        assert stepped.calculate_auprc() == pytest.approx(
+            exact.calculate_auprc(), abs=0.02)
+
+    def test_one_hot_two_column_input(self):
+        """[N,2] one-hot labels + softmax probs: column 1 is positive."""
+        roc = ROC()
+        y = np.array([[1, 0], [1, 0], [0, 1], [0, 1]], np.float32)
+        p = np.array([[0.9, 0.1], [0.6, 0.4], [0.65, 0.35], [0.2, 0.8]],
+                     np.float32)
+        roc.eval(y, p)
+        assert roc.calculate_auc() == pytest.approx(0.75)
+
+    def test_auprc_hand_computed(self):
+        """AP for the classic fixture = 0.8333... (sum of P(k)·ΔR)."""
+        roc = ROC()
+        roc.eval(np.array([0, 0, 1, 1.0]), np.array([0.1, 0.4, 0.35, 0.8]))
+        assert roc.calculate_auprc() == pytest.approx(0.8333333, abs=1e-6)
+
+    def test_merge_equals_single_pass(self):
+        rng = np.random.default_rng(1)
+        y = (rng.random(300) < 0.4).astype(float)
+        p = np.clip(y * 0.3 + rng.random(300) * 0.7, 0, 1)
+        whole = ROC()
+        whole.eval(y, p)
+        a, b = ROC(), ROC()
+        a.eval(y[:100], p[:100])
+        b.eval(y[100:], p[100:])
+        a.merge(b)
+        assert a.calculate_auc() == pytest.approx(whole.calculate_auc())
+
+
+class TestROCThresholded:
+    def test_thresholded_approximates_exact(self):
+        rng = np.random.default_rng(2)
+        y = (rng.random(5000) < 0.5).astype(float)
+        p = np.clip(0.5 * y + rng.normal(0.25, 0.2, 5000), 0, 1)
+        exact = ROC()
+        exact.eval(y, p)
+        stepped = ROC(threshold_steps=200)
+        stepped.eval(y, p)
+        assert stepped.calculate_auc() == pytest.approx(
+            exact.calculate_auc(), abs=0.01)
+
+    def test_thresholded_merge(self):
+        rng = np.random.default_rng(3)
+        y = (rng.random(400) < 0.5).astype(float)
+        p = rng.random(400)
+        whole = ROC(threshold_steps=100)
+        whole.eval(y, p)
+        a, b = ROC(threshold_steps=100), ROC(threshold_steps=100)
+        a.eval(y[:200], p[:200])
+        b.eval(y[200:], p[200:])
+        a.merge(b)
+        assert a.calculate_auc() == pytest.approx(whole.calculate_auc())
+        with pytest.raises(ValueError):
+            a.merge(ROC(threshold_steps=50))
+
+
+class TestROCBinaryMulti:
+    def test_binary_per_column(self):
+        y = np.array([[1, 0], [1, 1], [0, 1], [0, 0.]])
+        # col 0 perfectly ranked; col 1 perfectly ANTI-ranked (positives
+        # 0.1/0.2 score below negatives 0.9/0.8)
+        p = np.array([[0.9, 0.9], [0.8, 0.1], [0.1, 0.2], [0.2, 0.8]])
+        rb = ROCBinary()
+        rb.eval(y, p)
+        assert rb.num_labels() == 2
+        assert rb.calculate_auc(0) == pytest.approx(1.0)
+        assert rb.calculate_auc(1) == pytest.approx(0.0)
+        assert rb.calculate_average_auc() == pytest.approx(0.5)
+
+    def test_multiclass_one_vs_all(self):
+        rng = np.random.default_rng(4)
+        n = 600
+        true = rng.integers(0, 3, n)
+        y = np.eye(3)[true]
+        # good-but-noisy scores for the right class
+        p = rng.random((n, 3))
+        p[np.arange(n), true] += 1.0
+        p = p / p.sum(1, keepdims=True)
+        rm = ROCMultiClass()
+        rm.eval(y, p)
+        assert rm.num_classes() == 3
+        for c in range(3):
+            assert rm.calculate_auc(c) > 0.85
+        # degenerate scorer → ~0.5 per class
+        flat = ROCMultiClass()
+        flat.eval(y, rng.random((n, 3)))
+        assert flat.calculate_average_auc() == pytest.approx(0.5, abs=0.05)
+
+
+class TestTopNAndNamedStats:
+    def test_top_n_accuracy(self):
+        ev = Evaluation(top_n=2)
+        y = np.eye(4)[[0, 1, 2, 3]]
+        p = np.array([
+            [0.9, 0.05, 0.03, 0.02],   # top1 correct
+            [0.5, 0.4, 0.05, 0.05],    # top1 wrong, top2 correct
+            [0.4, 0.35, 0.15, 0.1],    # not in top2
+            [0.05, 0.05, 0.2, 0.7],    # top1 correct
+        ])
+        ev.eval(y, p)
+        assert ev.accuracy() == pytest.approx(0.5)
+        assert ev.top_n_accuracy() == pytest.approx(0.75)
+
+    def test_top_n_merge(self):
+        y = np.eye(3)[[0, 1, 2, 0]]
+        p = np.array([[0.6, 0.3, 0.1], [0.5, 0.4, 0.1],
+                      [0.1, 0.5, 0.4], [0.2, 0.5, 0.3]])
+        whole = Evaluation(top_n=2)
+        whole.eval(y, p)
+        a, b = Evaluation(top_n=2), Evaluation(top_n=2)
+        a.eval(y[:2], p[:2])
+        b.eval(y[2:], p[2:])
+        a.merge(b)
+        assert a.top_n_accuracy() == whole.top_n_accuracy()
+
+    def test_label_named_stats(self):
+        ev = Evaluation(label_names=["cat", "dog", "fish"])
+        y = np.eye(3)[[0, 0, 1, 2, 2, 2]]
+        p = np.eye(3)[[0, 1, 1, 2, 2, 0]]
+        ev.eval(y, p)
+        s = ev.stats()
+        assert "cat:" in s and "dog:" in s and "fish:" in s
+        assert "Per-class" in s
+        assert ev.label_name(1) == "dog"
+        assert ev.recall(2) == pytest.approx(2 / 3)

@@ -1,0 +1,104 @@
+"""Observability tests: StatsListener -> storage -> report (reference
+TestStatsListener / TestStatsStorage strategy: a training run must produce
+a parseable stats artifact)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (Adam, DenseLayer, InputType,
+                                MultiLayerNetwork, NeuralNetConfiguration,
+                                OutputLayer)
+from deeplearning4j_tpu.ui import (FileStatsStorage, InMemoryStatsStorage,
+                                   StatsListener, StatsUpdateConfiguration,
+                                   export_json, render_html_report)
+
+
+def _train(storage, config=None, iters=12):
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(0.01))
+            .list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(8)).build())
+    net = MultiLayerNetwork(conf).init()
+    lst = StatsListener(storage, session_id="test-session", config=config)
+    net.add_listener(lst)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((64, 8)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 64)]
+    net.fit(x, y, epochs=iters // 2, batch_size=32)
+    return net
+
+
+class TestStatsPipeline:
+    def test_in_memory_records(self):
+        storage = InMemoryStatsStorage()
+        _train(storage, StatsUpdateConfiguration(
+            collect_histograms=True, collect_updates=True))
+        assert storage.list_session_ids() == ["test-session"]
+        ups = [u for u in storage.get_updates("test-session")
+               if "epoch_end" not in u]
+        assert len(ups) >= 10
+        rec = ups[-1]
+        assert np.isfinite(rec["score"])
+        assert rec["iteration_ms"] > 0
+        assert rec["host_max_rss_mb"] > 0
+        assert "layer0/W" in rec["param_mean_magnitudes"]
+        assert sum(rec["param_histograms"]["layer0/W"]["counts"]) == 8 * 16
+        assert rec["update_mean_magnitudes"]["layer1/W"] > 0
+        # epoch markers present
+        assert any("epoch_end" in u
+                   for u in storage.get_updates("test-session"))
+
+    def test_scores_decrease_over_run(self):
+        storage = InMemoryStatsStorage()
+        _train(storage, iters=30)
+        scores = [u["score"] for u in storage.get_updates("test-session")
+                  if u.get("score") is not None]
+        assert scores[-1] < scores[0]
+
+    def test_file_storage_persists(self, tmp_path):
+        p = str(tmp_path / "stats.jsonl")
+        _train(FileStatsStorage(p))
+        # fresh handle reads what a previous process wrote
+        back = FileStatsStorage(p)
+        assert back.list_session_ids() == ["test-session"]
+        ups = back.get_updates("test-session")
+        assert len(ups) >= 6
+        assert back.get_latest_update("test-session")["iteration"] >= \
+            ups[0]["iteration"]
+
+    def test_html_report_and_json_export(self, tmp_path):
+        storage = InMemoryStatsStorage()
+        _train(storage, StatsUpdateConfiguration(collect_histograms=True))
+        out = str(tmp_path / "report.html")
+        render_html_report(storage, out)
+        text = open(out).read()
+        assert "<svg" in text and "Training report" in text
+        assert "layer1/W" in text
+        # embedded machine-readable block round-trips
+        start = text.index('id="stats-data">') + len('id="stats-data">')
+        end = text.index("</script>", start)
+        data = json.loads(text[start:end])
+        assert data["session"] == "test-session"
+        assert any(u.get("score") is not None for u in data["updates"])
+        # standalone JSON export parses too
+        doc = json.loads(export_json(storage))
+        assert doc["updates"]
+
+    def test_frequency_thins_records(self):
+        storage = InMemoryStatsStorage()
+        conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(0.01))
+                .list()
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(8)).build())
+        net = MultiLayerNetwork(conf).init()
+        net.add_listener(StatsListener(storage, frequency=5, session_id="s"))
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((64, 8)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 64)]
+        net.fit(x, y, epochs=10, batch_size=32)  # 20 iterations
+        ups = [u for u in storage.get_updates("s") if "epoch_end" not in u]
+        assert len(ups) == 4  # iterations 5, 10, 15, 20
