@@ -33,7 +33,9 @@ def _to_class_indices(arr: np.ndarray, mask: Optional[np.ndarray] = None):
             m = np.asarray(mask).reshape(-1)
             keep = m > 0
         return np.argmax(arr, axis=-1), keep
-    return arr.astype(np.int64), None
+    # rank-1 class indices: the mask still applies
+    keep = None if mask is None else np.asarray(mask).reshape(-1) > 0
+    return arr.astype(np.int64), keep
 
 
 class Evaluation:

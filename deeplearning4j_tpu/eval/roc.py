@@ -211,8 +211,10 @@ class _PerColumnROC:
                 y, p = y[keep], p[keep]
                 mask = None
         self._ensure(y.shape[1])
+        m = None if mask is None else np.asarray(mask)
         for c in range(y.shape[1]):
-            self._rocs[c].eval(y[:, c:c + 1], p[:, c:c + 1], mask)
+            col_mask = m[:, c] if (m is not None and m.ndim == 2) else m
+            self._rocs[c].eval(y[:, c:c + 1], p[:, c:c + 1], col_mask)
 
     def calculate_auc(self, col: int) -> float:
         return self._rocs[col].calculate_auc()

@@ -1,4 +1,4 @@
 """Model zoo (reference deeplearning4j-zoo)."""
-from .zoo import (AlexNet, GoogLeNet, LeNet, ResNet50, SimpleCNN,
-                  TextGenerationLSTM, VGG16, VGG19, ZooModel, ZooType,
-                  model_selector)
+from .zoo import (AlexNet, FaceNetNN4Small2, GoogLeNet, InceptionResNetV1,
+                  LeNet, ResNet50, SimpleCNN, TextGenerationLSTM, VGG16,
+                  VGG19, ZooModel, ZooType, model_selector)

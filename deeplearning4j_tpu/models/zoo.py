@@ -498,6 +498,140 @@ class GoogLeNet(ZooModel):
         return g.build()
 
 
+@dataclass
+class InceptionResNetV1(ZooModel):
+    """Reference zoo/model/InceptionResNetV1.java (:75 init adds the
+    bottleneck + center-loss head onto graphBuilder :101; blocks via
+    InceptionResNetHelper) — Szegedy et al., arXiv 1602.07261. Face-
+    recognition scale: 160×160×3 input, 128-d embedding, center loss."""
+
+    num_labels: int = 1001
+    input_shape: Sequence[int] = (160, 160, 3)
+    embedding_size: int = 128
+
+    def conf(self) -> ComputationGraphConfiguration:
+        from .helpers import (conv_bn, inception_resnet_a,
+                              inception_resnet_b, inception_resnet_c,
+                              reduction_a, reduction_b)
+        from ..nn.layers.pretrain import CenterLossOutputLayer
+        h, w, c = self.input_shape
+        g = (NeuralNetConfiguration.builder()
+             .seed(self.seed)
+             .activation("identity")
+             .updater(RmsProp(learning_rate=0.1, rms_decay=0.96,
+                              epsilon=0.001))
+             .weight_init(WeightInit.DISTRIBUTION)
+             .dist(Distribution(kind="normal", mean=0.0, std=0.5))
+             .graph_builder())
+        g.add_inputs("input")
+        g.set_input_types(InputType.convolutional(h, w, c))
+        # stem (reference graphBuilder :101-167)
+        x = conv_bn(g, "stem1", "input", 32, (3, 3), (2, 2))
+        x = conv_bn(g, "stem2", x, 32, (3, 3))
+        x = conv_bn(g, "stem3", x, 64, (3, 3))
+        g.add_layer("stem-pool", SubsamplingLayer(
+            kernel_size=(3, 3), stride=(2, 2),
+            pooling_type=PoolingType.MAX,
+            convolution_mode=ConvolutionMode.SAME), x)
+        x = conv_bn(g, "stem4", "stem-pool", 80, (1, 1))
+        x = conv_bn(g, "stem5", x, 192, (3, 3))
+        x = conv_bn(g, "stem6", x, 256, (3, 3), (2, 2))
+        # 5× Inception-ResNet-A @ scale .17 (reference :167)
+        x = inception_resnet_a(g, "resnetA", 5, 0.17, x)
+        x = reduction_a(g, "reduceA", x)
+        # 10× Inception-ResNet-B @ .10 (reference :220); width follows the
+        # merge of reduction-A (256 + 384 + 256 = 896)
+        x = inception_resnet_b(g, "resnetB", 10, 0.10, x, width=896)
+        x = reduction_b(g, "reduceB", x)
+        # 5× Inception-ResNet-C @ .20 (reference :302); 896+384+256+256
+        x = inception_resnet_c(g, "resnetC", 5, 0.20, x, width=1792)
+        g.add_layer("avgpool", GlobalPoolingLayer(
+            pooling_type=PoolingType.AVG), x)
+        # bottleneck embedding + L2 normalize + center loss (init :75-99)
+        g.add_layer("bottleneck", DenseLayer(
+            n_out=self.embedding_size, activation="identity"), "avgpool")
+        from ..nn.graph import L2NormalizeVertex
+        g.add_vertex("embeddings", L2NormalizeVertex(), "bottleneck")
+        g.add_layer("lossLayer", CenterLossOutputLayer(
+            n_out=self.num_labels, activation="softmax", loss="mcxent",
+            alpha=0.9, lambda_=1e-4), "embeddings")
+        g.set_outputs("lossLayer")
+        return g.build()
+
+
+@dataclass
+class FaceNetNN4Small2(ZooModel):
+    """Reference zoo/model/FaceNetNN4Small2.java (:322-335 tail:
+    avgpool → bottleneck dense → L2NormalizeVertex 'embeddings' →
+    CenterLossOutputLayer; inception modules via FaceNetHelper) —
+    Schroff et al. FaceNet, OpenFace nn4.small2 variant, 96×96×3."""
+
+    num_labels: int = 5749
+    input_shape: Sequence[int] = (96, 96, 3)
+    embedding_size: int = 128
+
+    def conf(self) -> ComputationGraphConfiguration:
+        from .helpers import conv_bn, facenet_inception
+        from ..nn.layers.pretrain import CenterLossOutputLayer
+        h, w, c = self.input_shape
+        g = (NeuralNetConfiguration.builder()
+             .seed(self.seed)
+             .activation("relu")
+             .updater(Nesterovs(learning_rate=0.001, momentum=0.9))
+             .weight_init(WeightInit.RELU)
+             .graph_builder())
+        g.add_inputs("input")
+        g.set_input_types(InputType.convolutional(h, w, c))
+        x = conv_bn(g, "stem1", "input", 64, (7, 7), (2, 2))
+        g.add_layer("pool1", SubsamplingLayer(
+            kernel_size=(3, 3), stride=(2, 2),
+            pooling_type=PoolingType.MAX,
+            convolution_mode=ConvolutionMode.SAME), x)
+        x = conv_bn(g, "stem2", "pool1", 64, (1, 1))
+        x = conv_bn(g, "stem3", x, 192, (3, 3))
+        g.add_layer("pool2", SubsamplingLayer(
+            kernel_size=(3, 3), stride=(2, 2),
+            pooling_type=PoolingType.MAX,
+            convolution_mode=ConvolutionMode.SAME), x)
+        # nn4.small2 inception stack (OpenFace table; reference
+        # FaceNetHelper.appendGraph calls)
+        x = facenet_inception(g, "inception3a", "pool2", c1x1=64,
+                              c3x3_reduce=96, c3x3=128, c5x5_reduce=16,
+                              c5x5=32, pool_proj=32)
+        x = facenet_inception(g, "inception3b", x, c1x1=64,
+                              c3x3_reduce=96, c3x3=128, c5x5_reduce=32,
+                              c5x5=64, pool_proj=64,
+                              pool_type=PoolingType.AVG)
+        x = facenet_inception(g, "inception3c", x, c1x1=0,
+                              c3x3_reduce=128, c3x3=256, c5x5_reduce=32,
+                              c5x5=64, pool_proj=0, stride3x3=(2, 2),
+                              pool_stride=(2, 2))
+        x = facenet_inception(g, "inception4a", x, c1x1=256,
+                              c3x3_reduce=96, c3x3=192, c5x5_reduce=32,
+                              c5x5=64, pool_proj=128,
+                              pool_type=PoolingType.AVG)
+        x = facenet_inception(g, "inception4e", x, c1x1=0,
+                              c3x3_reduce=160, c3x3=256, c5x5_reduce=64,
+                              c5x5=128, pool_proj=0, stride3x3=(2, 2),
+                              pool_stride=(2, 2))
+        x = facenet_inception(g, "inception5a", x, c1x1=256,
+                              c3x3_reduce=96, c3x3=384, pool_proj=96,
+                              pool_type=PoolingType.AVG)
+        x = facenet_inception(g, "inception5b", x, c1x1=256,
+                              c3x3_reduce=96, c3x3=384, pool_proj=96)
+        g.add_layer("avgpool", GlobalPoolingLayer(
+            pooling_type=PoolingType.AVG), x)
+        g.add_layer("bottleneck", DenseLayer(
+            n_out=self.embedding_size, activation="identity"), "avgpool")
+        from ..nn.graph import L2NormalizeVertex
+        g.add_vertex("embeddings", L2NormalizeVertex(), "bottleneck")
+        g.add_layer("lossLayer", CenterLossOutputLayer(
+            n_out=self.num_labels, activation="softmax", loss="mcxent",
+            alpha=0.9, lambda_=1e-4), "embeddings")
+        g.set_outputs("lossLayer")
+        return g.build()
+
+
 class ZooType(enum.Enum):
     """Reference zoo/ZooType.java."""
 
@@ -509,6 +643,8 @@ class ZooType(enum.Enum):
     RESNET50 = "resnet50"
     GOOGLENET = "googlenet"
     TEXTGENLSTM = "textgenlstm"
+    INCEPTIONRESNETV1 = "inceptionresnetv1"
+    FACENETNN4SMALL2 = "facenetnn4small2"
 
 
 _ZOO = {
@@ -520,6 +656,8 @@ _ZOO = {
     ZooType.RESNET50: ResNet50,
     ZooType.GOOGLENET: GoogLeNet,
     ZooType.TEXTGENLSTM: TextGenerationLSTM,
+    ZooType.INCEPTIONRESNETV1: InceptionResNetV1,
+    ZooType.FACENETNN4SMALL2: FaceNetNN4Small2,
 }
 
 

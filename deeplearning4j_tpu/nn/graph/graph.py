@@ -68,6 +68,9 @@ class ComputationGraph:
         self._initialized = False
         self._layer_nodes = [n for n in conf.topo_order
                              if conf.nodes[n].is_layer()]
+        # Streaming/tBPTT recurrent carry, keyed by node name (the MLN
+        # _rnn_carry analog; reference ComputationGraph rnn state maps).
+        self._rnn_carry: Optional[Dict[str, dict]] = None
 
     # ------------------------------------------------------------------ init
     def init(self, seed: Optional[int] = None, dtype=jnp.float32
@@ -208,6 +211,13 @@ class ComputationGraph:
             self._loss_pure(params, state, inputs, labels, fmasks, lmasks,
                             None, False)[0])
 
+        def rnn_step(params, state, inputs):
+            acts, new_state, _, _ = self._walk(params, state, inputs,
+                                               False, None, {})
+            return [acts[n] for n in conf.network_outputs], new_state
+
+        self._rnn_step_fn = jax.jit(rnn_step)
+
     # ----------------------------------------------------------------- data
     def _coerce(self, data, labels=None) -> MultiDataSet:
         if isinstance(data, MultiDataSet):
@@ -269,10 +279,6 @@ class ComputationGraph:
         from ...data.iterators import AsyncMultiDataSetIterator
         self._check_init()
         step = step_fn or self.fit_batch
-        if self.conf.backprop_type == BackpropType.TRUNCATED_BPTT:
-            raise NotImplementedError(
-                "tBPTT for ComputationGraph is not implemented yet; use "
-                "standard backprop or MultiLayerNetwork tBPTT")
         if hasattr(data, "__iter__") and not isinstance(
                 data, (DataSet, MultiDataSet, list, tuple, np.ndarray)):
             iterator = data
@@ -299,7 +305,108 @@ class ComputationGraph:
         return self
 
     def fit_batch(self, mds: MultiDataSet):
+        mds = self._coerce(mds)
+        if self.conf.backprop_type == BackpropType.TRUNCATED_BPTT:
+            f0 = np.asarray(mds.features[0])
+            labels_rank3 = all(np.asarray(l).ndim == 3 for l in mds.labels)
+            if f0.ndim == 3 and labels_rank3:
+                self._fit_tbptt(mds)
+                return
+            if not getattr(self, "_warned_tbptt_labels", False):
+                import logging
+                logging.getLogger(__name__).warning(
+                    "Truncated BPTT requires rank-3 features and labels; "
+                    "using standard BPTT")
+                self._warned_tbptt_labels = True
+        self._rnn_carry = None  # standard BPTT: every batch starts fresh
         self._run_and_commit(*self._pack(mds))
+
+    def _fit_tbptt(self, mds: MultiDataSet):
+        """Truncated BPTT over the graph: slide tbptt_fwd_length windows
+        over the time axis of every rank-3 array, one optimizer step per
+        window with recurrent state carried between windows (the
+        MultiLayerNetwork._fit_tbptt analog; reference ComputationGraph
+        doTruncatedBPTT). Rank-2 (static) inputs pass whole into every
+        window."""
+        T = max(np.asarray(f).shape[1] for f in mds.features
+                if np.asarray(f).ndim == 3)
+        L = self.conf.tbptt_fwd_length
+        batch = np.asarray(mds.features[0]).shape[0]
+        self.rnn_clear_previous_state()
+        self._seed_recurrent_states(batch)
+        sl3 = lambda a, s, e: None if a is None else \
+            (a[:, s:e] if np.asarray(a).ndim >= 2 and
+             np.asarray(a).shape[1] >= T else a)
+        for start in range(0, T, L):
+            end = min(start + L, T)
+            win = MultiDataSet(
+                [f[:, start:end] if np.asarray(f).ndim == 3 else f
+                 for f in mds.features],
+                [l[:, start:end] for l in mds.labels],
+                None if mds.features_masks is None else
+                [sl3(m, start, end) for m in mds.features_masks],
+                None if mds.labels_masks is None else
+                [sl3(m, start, end) for m in mds.labels_masks])
+            self._run_and_commit(*self._pack(win))
+        self.rnn_clear_previous_state()
+
+    # ------------------------------------------------------------- rnn state
+    def _seed_recurrent_states(self, batch: int):
+        if self._rnn_carry is None:
+            self._rnn_carry = {
+                name: self.conf.nodes[name].layer.seed_recurrent_state(
+                    batch, self._dtype)
+                for name in self._layer_nodes
+                if self.conf.nodes[name].layer.is_recurrent()}
+
+    def rnn_clear_previous_state(self):
+        """Reference ComputationGraph.rnnClearPreviousState()."""
+        self._rnn_carry = None
+
+    def _merged_state(self):
+        if self._rnn_carry is None:
+            return self.state_tree
+        return {name: {**st, **self._rnn_carry.get(name, {})}
+                for name, st in self.state_tree.items()}
+
+    def _commit_state(self, new_state):
+        if self._rnn_carry is None:
+            self.state_tree = new_state
+            return
+        base, carry = {}, {}
+        for name, st in new_state.items():
+            carry[name] = {k: v for k, v in st.items() if k in ("h", "c")}
+            base[name] = {k: v for k, v in st.items()
+                          if k not in ("h", "c")}
+        self.state_tree = base
+        self._rnn_carry = {k: v for k, v in carry.items() if v}
+
+    def rnn_time_step(self, *features) -> List[np.ndarray]:
+        """Streaming inference with carried recurrent state (reference
+        ComputationGraph.rnnTimeStep)."""
+        self._check_init()
+        for name in self._layer_nodes:
+            layer = self.conf.nodes[name].layer
+            if layer.is_recurrent() and not layer.supports_streaming():
+                raise NotImplementedError(
+                    f"{type(layer).__name__} ({name!r}) does not support "
+                    "rnn_time_step")
+        if len(features) == 1 and isinstance(features[0], (list, tuple)):
+            features = tuple(features[0])
+        inputs, fmasks = self._pack_inputs(features)
+        batch = next(iter(inputs.values())).shape[0]
+        if self._rnn_carry is not None:
+            for carry in self._rnn_carry.values():
+                if "h" in carry and carry["h"].shape[0] != batch:
+                    raise ValueError(
+                        f"rnn_time_step batch size {batch} != stored state "
+                        f"batch size {carry['h'].shape[0]}; call "
+                        "rnn_clear_previous_state() between sequences")
+        self._seed_recurrent_states(batch)
+        outs, new_state = self._rnn_step_fn(
+            self.params_tree, self._merged_state(), inputs)
+        self._commit_state(new_state)
+        return [np.asarray(o) for o in outs]
 
     def _run_and_commit(self, inputs, labels, fmasks, lmasks, mesh=None):
         """Invoke the jitted step and commit results + listeners (shared by
@@ -307,11 +414,12 @@ class ComputationGraph:
         import contextlib
         with (mesh if mesh is not None else contextlib.nullcontext()):
             out = self._train_step_fn(
-                self.params_tree, self.opt_state, self.state_tree,
+                self.params_tree, self.opt_state, self._merged_state(),
                 jnp.asarray(self.iteration, jnp.int32), self._rng,
                 inputs, labels, fmasks, lmasks)
-        (self.params_tree, self.opt_state, self.state_tree, _, self._rng,
+        (self.params_tree, self.opt_state, new_state, _, self._rng,
          loss) = out
+        self._commit_state(new_state)
         self.iteration += 1
         self.score_value = loss
         for lst in self.listeners:

@@ -178,3 +178,23 @@ class TestTopNAndNamedStats:
         assert "Per-class" in s
         assert ev.label_name(1) == "dog"
         assert ev.recall(2) == pytest.approx(2 / 3)
+
+
+class TestMaskHandling:
+    def test_rocbinary_per_column_mask(self):
+        from deeplearning4j_tpu import ROCBinary
+        y = np.array([[1, 0], [0, 1], [1, 1], [0, 0.]])
+        p = np.array([[0.9, 0.2], [0.1, 0.8], [0.7, 0.6], [0.3, 0.4]])
+        m = np.array([[1, 1], [1, 0], [0, 1], [1, 1.]])  # per-column mask
+        rb = ROCBinary()
+        rb.eval(y, p, mask=m)  # used to crash on rank-2 masks
+        # column 0 keeps rows 0,1,3 → perfect ranking
+        assert rb.calculate_auc(0) == pytest.approx(1.0)
+
+    def test_evaluation_rank1_labels_honor_mask(self):
+        ev = Evaluation()
+        ev.eval(np.array([0, 1, 0]),
+                np.array([[0.9, 0.1], [0.1, 0.9], [0.1, 0.9]]),
+                mask=np.array([1, 1, 0]))
+        assert ev.num_examples() == 2  # masked row must not count
+        assert ev.accuracy() == 1.0

@@ -102,3 +102,18 @@ class TestStatsPipeline:
         net.fit(x, y, epochs=10, batch_size=32)  # 20 iterations
         ups = [u for u in storage.get_updates("s") if "epoch_end" not in u]
         assert len(ups) == 4  # iterations 5, 10, 15, 20
+
+
+def test_report_escapes_script_terminator(tmp_path):
+    """A session id containing '</script>' must not truncate the report."""
+    from deeplearning4j_tpu.ui import InMemoryStatsStorage, render_html_report
+    storage = InMemoryStatsStorage()
+    sid = "run</script><b>x"
+    storage.put_update(sid, {"iteration": 1, "timestamp": 0.0, "score": 1.0})
+    out = str(tmp_path / "r.html")
+    render_html_report(storage, out)
+    text = open(out).read()
+    start = text.index('id="stats-data">') + len('id="stats-data">')
+    end = text.index("</script>", start)
+    data = json.loads(text[start:end])
+    assert data["updates"][0]["score"] == 1.0

@@ -84,3 +84,40 @@ class TestZooInstantiation:
         assert isinstance(m, LeNet) and m.num_labels == 3
         with pytest.raises(ValueError):
             model_selector("nope")
+
+
+class TestZooCompletion:
+    """Round-2 additions: the final 2 of the reference's 10 models
+    (InceptionResNetV1.java, FaceNetNN4Small2.java) — face-recognition
+    graphs with bottleneck embedding, L2-normalize vertex, center loss."""
+
+    def test_inception_resnet_v1(self):
+        from deeplearning4j_tpu.models import InceptionResNetV1
+        model = InceptionResNetV1(num_labels=7, input_shape=(64, 64, 3))
+        g = model.init()
+        assert isinstance(g, ComputationGraph)
+        x, y = _img_data(2, 64, 64, 3, 7)
+        out = g.output(x)
+        assert out.shape == (2, 7)
+        # embedding vertex exists and is L2-normalized in the graph walk
+        g.fit(MultiDataSet([x], [y]), epochs=1, batch_size=2,
+              use_async=False)
+        assert np.isfinite(float(g.score_value))
+
+    def test_facenet_nn4_small2(self):
+        from deeplearning4j_tpu.models import FaceNetNN4Small2
+        model = FaceNetNN4Small2(num_labels=9, input_shape=(96, 96, 3))
+        g = model.init()
+        x, y = _img_data(2, 96, 96, 3, 9)
+        out = g.output(x)
+        assert out.shape == (2, 9)
+        g.fit(MultiDataSet([x], [y]), epochs=1, batch_size=2,
+              use_async=False)
+        assert np.isfinite(float(g.score_value))
+
+    def test_model_selector_covers_all_ten(self):
+        from deeplearning4j_tpu.models import ZooType, model_selector
+        assert len(ZooType) == 10
+        for zt in ZooType:
+            m = model_selector(zt, num_labels=4)
+            assert m.num_labels == 4
