@@ -1,0 +1,5 @@
+"""Clustering / spatial algorithms (reference deeplearning4j-core
+clustering/ + plot/, SURVEY.md §2.2)."""
+from .kmeans import KMeansClustering
+from .tsne import Tsne
+from .vptree import VPTree, knn_brute_force

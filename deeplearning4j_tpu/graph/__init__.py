@@ -1,0 +1,3 @@
+"""Graph embeddings (reference deeplearning4j-graph, SURVEY.md §2.10)."""
+from .core import Graph, RandomWalkIterator
+from .deepwalk import DeepWalk

@@ -307,9 +307,11 @@ class ComputationGraph:
     def fit_batch(self, mds: MultiDataSet):
         mds = self._coerce(mds)
         if self.conf.backprop_type == BackpropType.TRUNCATED_BPTT:
-            f0 = np.asarray(mds.features[0])
+            # ANY rank-3 input triggers windowing (static rank-2 inputs
+            # pass whole into every window — _fit_tbptt handles the mix).
+            any_seq = any(np.asarray(f).ndim == 3 for f in mds.features)
             labels_rank3 = all(np.asarray(l).ndim == 3 for l in mds.labels)
-            if f0.ndim == 3 and labels_rank3:
+            if any_seq and labels_rank3:
                 self._fit_tbptt(mds)
                 return
             if not getattr(self, "_warned_tbptt_labels", False):

@@ -201,6 +201,14 @@ class ParallelWrapper:
             net._check_init()
             self._place_model()
         if hasattr(net, "_pack"):  # ComputationGraph
+            from ..nn.conf.builders import BackpropType
+            if net.conf.backprop_type == BackpropType.TRUNCATED_BPTT:
+                # This path calls _run_and_commit directly and would
+                # silently skip the graph's tBPTT windowing.
+                raise NotImplementedError(
+                    "ParallelWrapper does not support ComputationGraph "
+                    "truncated BPTT yet; train single-device or use "
+                    "standard backprop")
             inputs, labels, fm, lm, _ = self._prep_graph_batch(ds)
             shard = lambda d: {k: self._shard_arr(v) for k, v in d.items()}
             net._run_and_commit(shard(inputs), shard(labels), shard(fm),

@@ -1,0 +1,3 @@
+"""Serving: k-NN REST server (reference
+deeplearning4j-nearestneighbor-server, SURVEY.md §2.11)."""
+from .nearest_neighbor import NearestNeighbor, NearestNeighborsServer

@@ -93,7 +93,7 @@ border-bottom:1px solid #eee}}h2{{margin-top:1.4em}}</style></head>
 <h2>Parameter mean magnitudes (last iteration)</h2>
 <table>{mm_table}</table>
 <script type="application/json" id="stats-data">
-{export_json(storage, sid).replace("</", "<\\/")}
+{export_json(storage, sid).replace("<", "\\u003c")}
 </script>
 </body></html>"""
     with open(path, "w") as f:

@@ -1,0 +1,163 @@
+"""Satellite subsystems: clustering (VPTree/KMeans/t-SNE), DeepWalk,
+k-NN server (reference test strategy: VPTree == brute force; DeepWalk
+separates communities; server round-trips queries)."""
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import (KMeansClustering, Tsne, VPTree,
+                                           knn_brute_force)
+from deeplearning4j_tpu.graph import DeepWalk, Graph, RandomWalkIterator
+from deeplearning4j_tpu.serving import NearestNeighborsServer
+
+
+class TestVPTree:
+    def test_matches_brute_force(self):
+        """The reference's own bar: VPTree results == linear scan."""
+        rng = np.random.default_rng(0)
+        pts = rng.standard_normal((300, 8)).astype(np.float32)
+        tree = VPTree(pts, metric="euclidean", seed=1)
+        for qi in range(5):
+            q = rng.standard_normal(8).astype(np.float32)
+            idx, dist = tree.search(q, 7)
+            brute = np.argsort(np.linalg.norm(pts - q, axis=1))[:7]
+            np.testing.assert_array_equal(np.sort(idx), np.sort(brute))
+            assert np.all(np.diff(dist) >= -1e-12)  # ascending
+
+    def test_cosine_metric(self):
+        rng = np.random.default_rng(1)
+        pts = rng.standard_normal((100, 6)).astype(np.float32)
+        tree = VPTree(pts, metric="cosine")
+        q = pts[17] * 3.0  # same direction, different norm
+        idx, dist = tree.search(q, 1)
+        assert idx[0] == 17 and dist[0] < 1e-6
+
+    def test_device_brute_force_matches_host(self):
+        rng = np.random.default_rng(2)
+        pts = rng.standard_normal((200, 5)).astype(np.float32)
+        qs = rng.standard_normal((4, 5)).astype(np.float32)
+        idx, dist = knn_brute_force(pts, qs, 5)
+        assert idx.shape == (4, 5)
+        for r, q in enumerate(qs):
+            brute = np.argsort(np.linalg.norm(pts - q, axis=1))[:5]
+            np.testing.assert_array_equal(idx[r], brute)
+
+
+class TestKMeans:
+    def test_recovers_separated_clusters(self):
+        rng = np.random.default_rng(3)
+        centers = np.array([[0, 0], [10, 0], [0, 10]], np.float32)
+        pts = np.concatenate([
+            c + rng.normal(0, 0.5, (60, 2)) for c in centers]).astype(
+                np.float32)
+        km = KMeansClustering(k=3, seed=5).fit(pts)
+        labels = km.predict(pts)
+        # each true cluster maps to one dominant predicted label
+        for c in range(3):
+            block = labels[c * 60:(c + 1) * 60]
+            dominant = np.bincount(block).max()
+            assert dominant >= 58, block
+        # centroids near the truth (in some order)
+        d = np.linalg.norm(km.centroids[:, None] - centers[None], axis=-1)
+        assert d.min(axis=0).max() < 0.5
+        assert km.iterations_run < 100
+
+    def test_predict_before_fit_raises(self):
+        with pytest.raises(RuntimeError):
+            KMeansClustering(k=2).predict(np.zeros((3, 2), np.float32))
+
+
+class TestTsne:
+    def test_separates_two_blobs(self):
+        rng = np.random.default_rng(4)
+        a = rng.normal(0, 0.3, (40, 10))
+        b = rng.normal(4, 0.3, (40, 10))
+        x = np.concatenate([a, b]).astype(np.float32)
+        ts = Tsne(perplexity=10, n_iter=300, seed=1)
+        y = ts.fit_transform(x)
+        assert y.shape == (80, 2)
+        assert np.isfinite(ts.kl_divergence)
+        # embedded clusters separate: inter-centroid distance beats spread
+        ca, cb = y[:40].mean(0), y[40:].mean(0)
+        spread = max(y[:40].std(), y[40:].std())
+        assert np.linalg.norm(ca - cb) > 3 * spread
+
+    def test_perplexity_guard(self):
+        with pytest.raises(ValueError, match="perplexity"):
+            Tsne(perplexity=30).fit_transform(np.zeros((20, 3)))
+
+
+class TestDeepWalk:
+    def _two_communities(self, n=16):
+        """Two dense cliques joined by a single bridge edge."""
+        g = Graph(2 * n)
+        for base in (0, n):
+            for i in range(n):
+                for j in range(i + 1, n):
+                    g.add_edge(base + i, base + j)
+        g.add_edge(0, n)  # bridge
+        return g
+
+    def test_walks_stay_valid(self):
+        g = self._two_communities(6)
+        walks = list(RandomWalkIterator(g, walk_length=8, seed=2))
+        assert len(walks) == 12
+        for w in walks:
+            assert len(w) == 8
+            for a, b in zip(w, w[1:]):
+                assert b in g.neighbors(a) or a == b
+
+    def test_embeddings_separate_communities(self):
+        g = self._two_communities(12)
+        dw = DeepWalk(vector_size=16, window_size=4, learning_rate=0.05,
+                      seed=3)
+        dw.fit(g, walk_length=20, walks_per_vertex=8, epochs=6)
+        same = np.mean([dw.similarity(1, j) for j in range(2, 10)])
+        cross = np.mean([dw.similarity(1, 12 + j) for j in range(2, 10)])
+        assert same > cross, (same, cross)
+        near = dw.verticies_nearest(5, top_n=6)
+        assert sum(1 for v in near if v < 12) >= 4, near
+
+    def test_save_load_roundtrip(self, tmp_path):
+        g = self._two_communities(5)
+        dw = DeepWalk(vector_size=8, seed=1)
+        dw.fit(g, walk_length=10, walks_per_vertex=4, epochs=2)
+        p = str(tmp_path / "gv.txt")
+        dw.save(p)
+        back = DeepWalk.load_vectors(p)
+        assert len(back) == 10
+        np.testing.assert_allclose(back[3], dw.get_vertex_vector(3),
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestNearestNeighborServer:
+    def test_rest_round_trip(self):
+        rng = np.random.default_rng(6)
+        pts = rng.standard_normal((150, 4)).astype(np.float32)
+        with NearestNeighborsServer(pts, port=0) as srv:
+            base = f"http://127.0.0.1:{srv.port}"
+            health = json.loads(urllib.request.urlopen(
+                base + "/health", timeout=10).read())
+            assert health == {"status": "ok", "corpus": 150, "dim": 4}
+            q = pts[42] + 0.001
+            req = urllib.request.Request(
+                base + "/knn",
+                data=json.dumps({"point": q.tolist(), "k": 3}).encode(),
+                headers={"Content-Type": "application/json"})
+            resp = json.loads(urllib.request.urlopen(req, timeout=30).read())
+            assert resp["results"][0]["index"] == 42
+            assert len(resp["results"]) == 3
+            # batched query + error path
+            req2 = urllib.request.Request(
+                base + "/knn",
+                data=json.dumps({"point": pts[:2].tolist(), "k": 2}).encode())
+            resp2 = json.loads(urllib.request.urlopen(req2, timeout=30).read())
+            assert len(resp2["results"]) == 2
+            bad = urllib.request.Request(base + "/knn", data=b"not json")
+            try:
+                urllib.request.urlopen(bad, timeout=10)
+                assert False, "expected 400"
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
