@@ -82,8 +82,13 @@ class NormalizerStandardize(DataNormalization):
 
     def transform(self, ds: DataSet) -> DataSet:
         m, s = self._stats()
-        return DataSet((np.asarray(ds.features) - m) / s, ds.labels,
-                       ds.features_mask, ds.labels_mask)
+        from .. import native_etl
+        feats = np.asarray(ds.features)
+        if native_etl.available() and feats.dtype == np.float32:
+            out = native_etl.standardize(feats, m, s)
+        else:
+            out = (feats - m) / s
+        return DataSet(out, ds.labels, ds.features_mask, ds.labels_mask)
 
     def revert(self, ds: DataSet) -> DataSet:
         m, s = self._stats()
@@ -151,8 +156,14 @@ class ImagePreProcessingScaler(DataNormalization):
         return self  # stateless, like the reference
 
     def transform(self, ds: DataSet) -> DataSet:
-        x = np.asarray(ds.features, np.float32) / self.max_pixel
-        x = x * (self.max_range - self.min_range) + self.min_range
+        feats = np.asarray(ds.features)
+        from .. import native_etl
+        if native_etl.available() and feats.dtype == np.uint8:
+            x = native_etl.u8_to_f32_scaled(
+                feats, self.max_pixel, self.min_range, self.max_range)
+        else:
+            x = np.asarray(feats, np.float32) / self.max_pixel
+            x = x * (self.max_range - self.min_range) + self.min_range
         return DataSet(x, ds.labels, ds.features_mask, ds.labels_mask)
 
     def revert(self, ds: DataSet) -> DataSet:

@@ -1,0 +1,161 @@
+"""ctypes binding for the native host-ETL library (native/etl.cpp).
+
+The library is OPTIONAL: `available()` is False when the shared object
+is missing and no C++ toolchain can build it, and every consumer
+(normalizers, fetchers) falls back to its numpy path — the same
+degrade-gracefully contract the reference uses for its optional cuDNN
+helper jar (ConvolutionLayer.java:66-77 reflective load)."""
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libdl4jtpu_etl.so")
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    src = os.path.join(_NATIVE_DIR, "etl.cpp")
+    if not os.path.exists(src):
+        return False
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                       capture_output=True, timeout=120)
+        return os.path.exists(_LIB_PATH)
+    except (subprocess.SubprocessError, OSError) as e:
+        log.info("native ETL build unavailable (%s); using numpy paths", e)
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if not os.path.exists(_LIB_PATH) and not _build():
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+        # AttributeError here means a stale/foreign .so — fall back.
+        if lib.etl_abi_version() != 1:
+            log.warning("native ETL ABI mismatch; using numpy paths")
+            return None
+        f32p = ctypes.POINTER(ctypes.c_float)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        lib.u8_to_f32_scaled.argtypes = [u8p, f32p, ctypes.c_int64,
+                                         ctypes.c_float, ctypes.c_float,
+                                         ctypes.c_float]
+        lib.f32_standardize.argtypes = [f32p, ctypes.c_int64,
+                                        ctypes.c_int64, f32p, f32p]
+        lib.parse_csv_floats.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                         ctypes.c_char, f32p,
+                                         ctypes.c_int64]
+        lib.parse_csv_floats.restype = ctypes.c_int64
+        lib.one_hot_f32.argtypes = [i32p, f32p, ctypes.c_int64,
+                                    ctypes.c_int64]
+        _lib = lib
+    except (OSError, AttributeError) as e:
+        log.info("native ETL load failed (%s); using numpy paths", e)
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _fptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def u8_to_f32_scaled(src: np.ndarray, max_pixel: float = 255.0,
+                     min_range: float = 0.0,
+                     max_range: float = 1.0) -> np.ndarray:
+    """uint8 → scaled float32 (ImagePreProcessingScaler hot path)."""
+    lib = _load()
+    src = np.ascontiguousarray(src, np.uint8)
+    if lib is None:
+        x = src.astype(np.float32) / max_pixel
+        return x * (max_range - min_range) + min_range
+    out = np.empty(src.shape, np.float32)
+    lib.u8_to_f32_scaled(
+        src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), _fptr(out),
+        src.size, max_pixel, min_range, max_range)
+    return out
+
+
+def standardize(data: np.ndarray, mean: np.ndarray,
+                std: np.ndarray) -> np.ndarray:
+    """(x - mean)/std over the trailing feature axis, native when
+    possible (NormalizerStandardize hot path). Returns a new array."""
+    lib = _load()
+    mean = np.ascontiguousarray(mean, np.float32)
+    std = np.ascontiguousarray(std, np.float32)
+    c = np.asarray(data).shape[-1]
+    if mean.shape != (c,) or std.shape != (c,):
+        # the numpy path would raise a broadcast error; the native kernel
+        # would read out of bounds — reject loudly either way.
+        raise ValueError(f"standardize: feature axis {c} != stats length "
+                         f"{mean.shape[0]}")
+    if lib is None:
+        return ((np.asarray(data) - mean) / std).astype(np.float32)
+    out = np.array(data, np.float32, order="C")  # exactly one owned copy
+    lib.f32_standardize(_fptr(out), out.size // c, c, _fptr(mean),
+                        _fptr(std))
+    return out
+
+
+def parse_csv_floats(text: bytes | str, delimiter: str = ",",
+                     max_out: Optional[int] = None) -> np.ndarray:
+    """Parse all floats out of a CSV chunk (CSVRecordReader fast path)."""
+    lib = _load()
+    if isinstance(text, str):
+        text = text.encode()
+    if lib is None:
+        toks = text.replace(b"\r", b"\n").replace(
+            delimiter.encode(), b"\n").split(b"\n")
+        out = []
+        for t in toks:
+            t = t.strip()
+            if not t:
+                continue
+            try:
+                out.append(float(t))
+            except ValueError:
+                # native strtof skips unparseable tokens; match it
+                continue
+        return np.array(out, np.float32)
+    cap = max_out if max_out is not None else len(text) // 2 + 1
+    out = np.empty(cap, np.float32)
+    n = lib.parse_csv_floats(text, len(text), delimiter.encode(),
+                             _fptr(out), cap)
+    return out[:n]
+
+
+def one_hot(labels: np.ndarray, classes: int) -> np.ndarray:
+    """1-D int labels → [n, classes] one-hot; out-of-range labels
+    (negative or >= classes) produce all-zero rows on BOTH paths."""
+    lib = _load()
+    labels = np.ascontiguousarray(labels, np.int32)
+    if labels.ndim != 1:
+        raise ValueError(f"one_hot needs 1-D labels, got {labels.shape}")
+    if lib is None:
+        out = np.zeros((labels.shape[0], classes), np.float32)
+        valid = (labels >= 0) & (labels < classes)
+        out[np.nonzero(valid)[0], labels[valid]] = 1.0
+        return out
+    out = np.empty((labels.shape[0], classes), np.float32)
+    lib.one_hot_f32(
+        labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), _fptr(out),
+        labels.shape[0], classes)
+    return out

@@ -1,0 +1,95 @@
+// Native host-ETL kernels for deeplearning4j_tpu.
+//
+// Reference parity: the reference keeps its hot host-side paths native —
+// libnd4j does buffer math behind JNI, JavaCPP binds HDF5 for model
+// import, and the MNIST/CSV readers feed DataSets through JVM-native IO.
+// On TPU the device math belongs to XLA, but host ETL (the feed side of
+// the async prefetch pipeline) still benefits from native code: pixel
+// scaling/layout conversion and CSV float parsing dominate host time
+// when the device step is fast.
+//
+// Build: make -C native   (g++ -O3 -shared -fPIC; no dependencies)
+// Python binding: ctypes (deeplearning4j_tpu/native_etl.py); every entry
+// point is plain C so no name mangling or pybind is involved.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+extern "C" {
+
+// uint8 pixels -> float32 in [min_range, max_range] (the
+// ImagePreProcessingScaler hot loop; dst may be the training batch
+// buffer directly).
+void u8_to_f32_scaled(const uint8_t* src, float* dst, int64_t n,
+                      float max_pixel, float min_range, float max_range) {
+    const float span = (max_range - min_range) / max_pixel;
+    for (int64_t i = 0; i < n; ++i) {
+        dst[i] = static_cast<float>(src[i]) * span + min_range;
+    }
+}
+
+// float32 standardize in place: (x - mean[c]) / std[c] over trailing
+// feature axis of size c_len (NormalizerStandardize.transform hot loop).
+void f32_standardize(float* data, int64_t rows, int64_t c_len,
+                     const float* mean, const float* stddev) {
+    for (int64_t r = 0; r < rows; ++r) {
+        float* row = data + r * c_len;
+        for (int64_t c = 0; c < c_len; ++c) {
+            row[c] = (row[c] - mean[c]) / stddev[c];
+        }
+    }
+}
+
+// Parse a delimiter-separated buffer of ASCII floats. Returns the number
+// parsed (<= max_out). Newlines count as delimiters; empty fields skip.
+// (CSVRecordReader's inner loop without Python string objects.)
+int64_t parse_csv_floats(const char* buf, int64_t len, char delimiter,
+                         float* out, int64_t max_out) {
+    int64_t count = 0;
+    const char* p = buf;
+    const char* end = buf + len;
+    while (p < end && count < max_out) {
+        // skip delimiters/newlines/spaces
+        while (p < end && (*p == delimiter || *p == '\n' || *p == '\r' ||
+                           *p == ' ')) {
+            ++p;
+        }
+        if (p >= end) break;
+        char* next = nullptr;
+        float v = strtof(p, &next);
+        if (next == p) {  // unparseable token: skip to next delimiter
+            while (p < end && *p != delimiter && *p != '\n') ++p;
+            continue;
+        }
+        out[count++] = v;
+        p = next;
+    }
+    return count;
+}
+
+// Gather rows: out[i] = table[idx[i]] for embedding-style host-side
+// assembly (word2vec negative-table sampling batches).
+void gather_rows_f32(const float* table, const int32_t* idx, float* out,
+                     int64_t n_rows, int64_t dim) {
+    for (int64_t i = 0; i < n_rows; ++i) {
+        std::memcpy(out + i * dim, table + static_cast<int64_t>(idx[i]) * dim,
+                    dim * sizeof(float));
+    }
+}
+
+// One-hot encode int labels into a zeroed float32 buffer [n, classes].
+void one_hot_f32(const int32_t* labels, float* out, int64_t n,
+                 int64_t classes) {
+    std::memset(out, 0, sizeof(float) * n * classes);
+    for (int64_t i = 0; i < n; ++i) {
+        int64_t c = labels[i];
+        if (c >= 0 && c < classes) {
+            out[i * classes + c] = 1.0f;
+        }
+    }
+}
+
+int etl_abi_version() { return 1; }
+
+}  // extern "C"

@@ -1,0 +1,92 @@
+"""Generate serialization-regression fixtures: checkpoints in the CURRENT
+format + recorded predictions, committed so future format changes must
+keep loading them (the reference's RegressionTest080.java family —
+SURVEY.md §4 'serialization regression' is a load-bearing test family).
+
+Run: python tests/fixtures/make_checkpoint_fixtures.py
+Regenerate ONLY when intentionally breaking format compatibility (and
+keep the old fixtures loading via a version shim if you do)."""
+import os
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from deeplearning4j_tpu import (LSTM, Adam, BatchNormalization,  # noqa: E402
+                                ComputationGraph, ConvolutionLayer,
+                                ConvolutionMode, DataSet, DenseLayer,
+                                InputType, MergeVertex, MultiLayerNetwork,
+                                NeuralNetConfiguration, NormalizerStandardize,
+                                OutputLayer, PoolingType, RnnOutputLayer,
+                                SubsamplingLayer)
+from deeplearning4j_tpu.utils.model_serializer import save_model  # noqa: E402
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+OUT = os.path.join(HERE, "checkpoints")
+os.makedirs(OUT, exist_ok=True)
+rng = np.random.default_rng(99)
+recorded = {}
+
+
+def record(name, net, x):
+    recorded[f"{name}_x"] = x
+    recorded[f"{name}_y"] = np.asarray(net.output(x))
+
+
+# 1. CNN MultiLayerNetwork (conv + pool + BN + dense) trained a few steps,
+#    with updater state and a fitted normalizer in the zip.
+cnn = (NeuralNetConfiguration.builder().seed(11).updater(Adam(1e-3))
+       .list()
+       .layer(ConvolutionLayer(kernel_size=(3, 3), n_out=6,
+                               convolution_mode=ConvolutionMode.SAME,
+                               activation="relu"))
+       .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2),
+                               pooling_type=PoolingType.MAX))
+       .layer(BatchNormalization())
+       .layer(DenseLayer(n_out=16, activation="relu"))
+       .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+       .set_input_type(InputType.convolutional(12, 12, 1)).build())
+net = MultiLayerNetwork(cnn).init()
+x = rng.standard_normal((16, 12, 12, 1)).astype(np.float32)
+y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 16)]
+net.fit(x, y, epochs=3, batch_size=8)
+norm = NormalizerStandardize().fit(DataSet(x.reshape(16, -1),
+                                           np.zeros((16, 1), np.float32)))
+save_model(net, os.path.join(OUT, "mln_cnn.zip"), normalizer=norm)
+record("mln_cnn", net, x[:4])
+
+# 2. Recurrent MultiLayerNetwork (LSTM) — exercises scan-state layers.
+rnn = (NeuralNetConfiguration.builder().seed(12).updater(Adam(1e-3))
+       .list()
+       .layer(LSTM(n_out=8, activation="tanh"))
+       .layer(RnnOutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+       .set_input_type(InputType.recurrent(5)).build())
+rnet = MultiLayerNetwork(rnn).init()
+xr = rng.standard_normal((6, 7, 5)).astype(np.float32)
+yr = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (6, 7))]
+rnet.fit(DataSet(xr, yr), epochs=3, batch_size=6)
+save_model(rnet, os.path.join(OUT, "mln_rnn.zip"))
+record("mln_rnn", rnet, xr[:2])
+
+# 3. ComputationGraph with a merge vertex.
+gconf = (NeuralNetConfiguration.builder().seed(13).updater(Adam(1e-3))
+         .graph_builder()
+         .add_inputs("in")
+         .add_layer("a", DenseLayer(n_out=8, activation="relu"), "in")
+         .add_layer("b", DenseLayer(n_out=8, activation="tanh"), "in")
+         .add_vertex("m", MergeVertex(), "a", "b")
+         .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                       loss="mcxent"), "m")
+         .set_outputs("out")
+         .set_input_types(InputType.feed_forward(6)).build())
+g = ComputationGraph(gconf).init()
+xg = rng.standard_normal((12, 6)).astype(np.float32)
+yg = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 12)]
+g.fit(xg, yg, epochs=3, batch_size=6, use_async=False)
+save_model(g, os.path.join(OUT, "graph_merge.zip"))
+record("graph_merge", g, xg[:3])
+
+np.savez(os.path.join(OUT, "expected.npz"), **recorded)
+print("Wrote", sorted(os.listdir(OUT)))
