@@ -24,8 +24,12 @@ class InMemoryModelSaver(EarlyStoppingModelSaver):
         self._best = None
 
     def save_best_model(self, model, score):
-        self._best = (model, model.params_tree, model.state_tree,
-                      model.opt_state)
+        from ..utils.params import tree_copy
+        # tree_copy, not aliases: the donated train step deletes the live
+        # buffers on the next fit epoch.
+        self._best = (model, tree_copy(model.params_tree),
+                      tree_copy(model.state_tree),
+                      tree_copy(model.opt_state))
 
     def get_best_model(self):
         """Returns a NEW network with the best-epoch arrays; the live

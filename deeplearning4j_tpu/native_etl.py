@@ -64,6 +64,8 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.parse_csv_floats.restype = ctypes.c_int64
         lib.one_hot_f32.argtypes = [i32p, f32p, ctypes.c_int64,
                                     ctypes.c_int64]
+        lib.gather_rows_f32.argtypes = [f32p, i32p, f32p, ctypes.c_int64,
+                                        ctypes.c_int64]
         _lib = lib
     except (OSError, AttributeError) as e:
         log.info("native ETL load failed (%s); using numpy paths", e)
@@ -122,24 +124,43 @@ def parse_csv_floats(text: bytes | str, delimiter: str = ",",
     if isinstance(text, str):
         text = text.encode()
     if lib is None:
-        toks = text.replace(b"\r", b"\n").replace(
-            delimiter.encode(), b"\n").split(b"\n")
+        # strtof-equivalent: parse the longest numeric PREFIX of each
+        # token ('7.5abc' → 7.5), treat spaces as separators, skip tokens
+        # with no numeric prefix — exactly what the native kernel does.
+        import re
+        num = re.compile(
+            rb"[+-]?(?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?")
         out = []
-        for t in toks:
-            t = t.strip()
-            if not t:
-                continue
-            try:
-                out.append(float(t))
-            except ValueError:
-                # native strtof skips unparseable tokens; match it
-                continue
+        for chunk in re.split(rb"[\n\r ]|" + re.escape(delimiter.encode()),
+                              text):
+            m = num.match(chunk)
+            if m:
+                out.append(float(m.group(0)))
         return np.array(out, np.float32)
     cap = max_out if max_out is not None else len(text) // 2 + 1
     out = np.empty(cap, np.float32)
     n = lib.parse_csv_floats(text, len(text), delimiter.encode(),
                              _fptr(out), cap)
     return out[:n]
+
+
+def gather_rows(table: np.ndarray, idx: np.ndarray) -> np.ndarray:
+    """out[i] = table[idx[i]] (host-side batch assembly for
+    embedding-style lookups). Indices must be in range."""
+    lib = _load()
+    table = np.ascontiguousarray(table, np.float32)
+    idx = np.ascontiguousarray(idx, np.int32)
+    if idx.ndim != 1 or table.ndim != 2:
+        raise ValueError("gather_rows needs 1-D idx over a 2-D table")
+    if idx.size and (idx.min() < 0 or idx.max() >= table.shape[0]):
+        raise IndexError("gather_rows index out of range")
+    if lib is None:
+        return table[idx]
+    out = np.empty((idx.shape[0], table.shape[1]), np.float32)
+    lib.gather_rows_f32(
+        _fptr(table), idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        _fptr(out), idx.shape[0], table.shape[1])
+    return out
 
 
 def one_hot(labels: np.ndarray, classes: int) -> np.ndarray:

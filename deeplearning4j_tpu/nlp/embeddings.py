@@ -176,8 +176,12 @@ def generate_pairs(indexed_sentences, window: int,
                    rng: np.random.Generator,
                    cache: Optional[VocabCache] = None,
                    sampling: float = 0.0):
-    """(center, context) pairs with word2vec's random dynamic window."""
+    """(center, context) pairs with word2vec's random dynamic window.
+    Vectorized per sentence (row-major pos×offset order and rng
+    consumption identical to the scalar loop it replaced — the host pair
+    generation was the words/sec bottleneck)."""
     centers, contexts = [], []
+    offs = np.arange(-window, window + 1)
     for ids in indexed_sentences:
         if sampling > 0 and cache is not None:
             ids = subsample(ids, cache, sampling, rng)
@@ -185,21 +189,25 @@ def generate_pairs(indexed_sentences, window: int,
         if n < 2:
             continue
         b = rng.integers(1, window + 1, size=n)
-        for pos in range(n):
-            w = b[pos]
-            for off in range(-w, w + 1):
-                j = pos + off
-                if off != 0 and 0 <= j < n:
-                    centers.append(ids[pos])
-                    contexts.append(ids[j])
-    return (np.array(centers, dtype=np.int32),
-            np.array(contexts, dtype=np.int32))
+        P = np.arange(n)[:, None] + offs[None, :]          # [n, 2w+1]
+        valid = (np.abs(offs)[None, :] <= b[:, None]) & \
+            (offs != 0)[None, :] & (P >= 0) & (P < n)
+        centers.append(np.repeat(ids, valid.sum(1)))
+        contexts.append(ids[P[valid]])
+    if not centers:
+        return (np.empty(0, np.int32), np.empty(0, np.int32))
+    return (np.concatenate(centers).astype(np.int32),
+            np.concatenate(contexts).astype(np.int32))
 
 
 def generate_cbow(indexed_sentences, window: int, rng: np.random.Generator,
                   cache=None, sampling: float = 0.0):
-    """(context-window [N, 2*window], center) with -1 padding."""
+    """(context-window [N, 2*window], center) with -1 padding. Vectorized
+    per sentence; pad slots (-1) sit at INVALID offset positions rather
+    than trailing — the device steps mask positionwise (contexts >= 0),
+    so the layouts are equivalent."""
     W = 2 * window
+    offs = np.concatenate([np.arange(-window, 0), np.arange(1, window + 1)])
     ctxs, centers = [], []
     for ids in indexed_sentences:
         if sampling > 0 and cache is not None:
@@ -208,17 +216,16 @@ def generate_cbow(indexed_sentences, window: int, rng: np.random.Generator,
         if n < 2:
             continue
         b = rng.integers(1, window + 1, size=n)
-        for pos in range(n):
-            w = b[pos]
-            row = [ids[pos + off] for off in range(-w, w + 1)
-                   if off != 0 and 0 <= pos + off < n]
-            if not row:
-                continue
-            row = row[:W] + [-1] * (W - len(row))
-            ctxs.append(row)
-            centers.append(ids[pos])
-    return (np.array(ctxs, dtype=np.int32).reshape(-1, W),
-            np.array(centers, dtype=np.int32))
+        P = np.arange(n)[:, None] + offs[None, :]          # [n, 2w]
+        valid = (np.abs(offs)[None, :] <= b[:, None]) & (P >= 0) & (P < n)
+        rows = np.where(valid, ids[np.clip(P, 0, n - 1)], -1).astype(np.int32)
+        keep = valid.any(1)
+        ctxs.append(rows[keep])
+        centers.append(ids[keep])
+    if not ctxs:
+        return (np.empty((0, W), np.int32), np.empty(0, np.int32))
+    return (np.concatenate(ctxs).astype(np.int32),
+            np.concatenate(centers).astype(np.int32))
 
 
 def codes_points_arrays(cache: VocabCache) -> Tuple[np.ndarray, np.ndarray]:

@@ -201,7 +201,8 @@ class ComputationGraph:
                     new_opt[name] = opt_i
             return (new_params, new_opt, new_state, iteration + 1, rng, loss)
 
-        self._train_step_fn = jax.jit(train_step)
+        # Donate params/opt/state (see MultiLayerNetwork._build_jitted).
+        self._train_step_fn = jax.jit(train_step, donate_argnums=(0, 1, 2))
         self._output_fn = jax.jit(
             lambda params, state, inputs, fmasks:
             [self._walk(params, state, inputs, False, None, fmasks)[0][n]

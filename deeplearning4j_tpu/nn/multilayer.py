@@ -180,7 +180,13 @@ class MultiLayerNetwork:
             return (tuple(new_params), tuple(new_opt), new_state,
                     iteration + 1, rng, loss)
 
-        self._train_step_fn = jax.jit(train_step)
+        # Donate params/opt/state: the step consumes and replaces them, so
+        # XLA reuses the buffers in place — less HBM churn per step (the
+        # workspace-reuse role of the reference's MemoryWorkspace). Trees
+        # crossing network boundaries (clone, transfer learning) are
+        # deep-copied at those seams so donation can never kill a shared
+        # buffer.
+        self._train_step_fn = jax.jit(train_step, donate_argnums=(0, 1, 2))
         self._output_fn = jax.jit(
             lambda params, state, x, fmask:
             self._forward_pure(params, state, x, False, None, fmask)[0])
@@ -502,9 +508,11 @@ class MultiLayerNetwork:
         net = MultiLayerNetwork(self.conf.clone())
         if self._initialized:
             net.init(dtype=self._dtype)
-            net.params_tree = self.params_tree
-            net.opt_state = self.opt_state
-            net.state_tree = self.state_tree
+            # Deep-copy: the donated train step reuses buffers in place,
+            # so shared arrays across nets would die on first fit.
+            net.params_tree = param_utils.tree_copy(self.params_tree)
+            net.opt_state = param_utils.tree_copy(self.opt_state)
+            net.state_tree = param_utils.tree_copy(self.state_tree)
             net.iteration = self.iteration
         return net
 

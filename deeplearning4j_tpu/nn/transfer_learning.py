@@ -149,11 +149,14 @@ class TransferLearningBuilder:
         # newly added layers keep their fresh init.
         new_params = list(new_net.params_tree)
         new_state = list(new_net.state_tree)
+        from ..utils.params import tree_copy as cp
         for i in range(min(first_new, len(old_params), len(new_params))):
             if i in reinit:
                 continue
-            new_params[i] = old_params[i]
-            new_state[i] = old_state[i]
+            # copy, don't alias: the donated train step reuses buffers in
+            # place, so sharing with the source net would corrupt it
+            new_params[i] = cp(old_params[i])
+            new_state[i] = cp(old_state[i])
         new_net.params_tree = tuple(new_params)
         new_net.state_tree = tuple(new_state)
         return new_net
@@ -180,10 +183,12 @@ class TransferLearningHelper:
                 if int(i) > self.frozen_until},
             seed=net.conf.seed)
         self.unfrozen = MultiLayerNetwork(tail_conf).init(dtype=net._dtype)
+        from ..utils.params import tree_copy as cp
+        # copy, don't alias (donated steps reuse buffers in place)
         self.unfrozen.params_tree = tuple(
-            net.params_tree[self.frozen_until + 1:])
+            cp(p) for p in net.params_tree[self.frozen_until + 1:])
         self.unfrozen.state_tree = tuple(
-            net.state_tree[self.frozen_until + 1:])
+            cp(s) for s in net.state_tree[self.frozen_until + 1:])
 
     def featurize(self, ds: DataSet) -> DataSet:
         """Activations at the frozen boundary (reference featurize)."""

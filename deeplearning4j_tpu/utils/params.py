@@ -46,3 +46,14 @@ def unflatten_params(template: Any, flat: Array) -> Any:
         raise ValueError(
             f"Flat vector length {flat.shape[0]} != template size {offset}")
     return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def tree_copy(tree: Any) -> Any:
+    """Deep-copy every array leaf. Load-bearing for buffer DONATION: the
+    jitted train steps reuse params/opt/state buffers in place
+    (donate_argnums), so any tree that crosses a network boundary (clone,
+    transfer learning, early-stopping savers) MUST be copied here or its
+    arrays die on the source net's next fit."""
+    import jax
+    import jax.numpy as jnp
+    return jax.tree_util.tree_map(jnp.copy, tree)

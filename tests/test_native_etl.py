@@ -63,3 +63,54 @@ class TestNativeEtl:
         s = np.asarray(norm.std, np.float32)
         np.testing.assert_allclose(out2.features, (x - m) / s, rtol=1e-5,
                                    atol=1e-6)
+
+
+class TestAdditionalKernels:
+    def test_gather_rows_parity(self):
+        rng = np.random.default_rng(3)
+        table = rng.standard_normal((50, 8)).astype(np.float32)
+        idx = rng.integers(0, 50, 17).astype(np.int32)
+        got = native_etl.gather_rows(table, idx)
+        np.testing.assert_array_equal(got, table[idx])
+        with pytest.raises(IndexError):
+            native_etl.gather_rows(table, np.array([50], np.int32))
+
+    def test_csv_fallback_prefix_semantics(self, monkeypatch):
+        """strtof semantics: numeric PREFIX parses, pure garbage skips,
+        spaces separate — identical on both paths (the fallback is forced
+        by blanking the loaded lib)."""
+        text = "7.5abc,nope,1 2,-.5e1"
+        native = native_etl.parse_csv_floats(text)
+        monkeypatch.setattr(native_etl, "_lib", None)
+        monkeypatch.setattr(native_etl, "_tried", True)
+        fallback = native_etl.parse_csv_floats(text)
+        np.testing.assert_allclose(native, [7.5, 1.0, 2.0, -5.0])
+        np.testing.assert_allclose(fallback, native)
+
+
+class TestEarlyStoppingDonationSafety:
+    def test_best_model_survives_later_epochs(self):
+        """Regression (review-found, live-reproduced): the in-memory saver
+        used to alias the live trees; the donated train step then deleted
+        the 'best' model's buffers on the next epoch."""
+        from deeplearning4j_tpu import (Adam, DenseLayer, InputType,
+                                        MultiLayerNetwork,
+                                        NeuralNetConfiguration, OutputLayer)
+        from deeplearning4j_tpu.earlystopping.savers import InMemoryModelSaver
+        conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(0.01))
+                .list()
+                .layer(DenseLayer(n_out=8, activation="relu"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((32, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+        saver = InMemoryModelSaver()
+        net.fit(x, y, epochs=1, batch_size=16)
+        saver.save_best_model(net, float(net.score_value))
+        net.fit(x, y, epochs=2, batch_size=16)  # donates the live buffers
+        best = saver.get_best_model()
+        out = best.output(x)  # used to raise 'Array has been deleted'
+        assert np.isfinite(out).all()
