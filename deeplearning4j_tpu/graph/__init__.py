@@ -1,3 +1,4 @@
 """Graph embeddings (reference deeplearning4j-graph, SURVEY.md §2.10)."""
 from .core import Graph, RandomWalkIterator
 from .deepwalk import DeepWalk
+from .node2vec import Node2Vec, Node2VecWalker

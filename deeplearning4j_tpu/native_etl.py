@@ -131,7 +131,7 @@ def parse_csv_floats(text: bytes | str, delimiter: str = ",",
         num = re.compile(
             rb"[+-]?(?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?")
         out = []
-        for chunk in re.split(rb"[\n\r ]|" + re.escape(delimiter.encode()),
+        for chunk in re.split(rb"[\n\r \t]|" + re.escape(delimiter.encode()),
                               text):
             m = num.match(chunk)
             if m:

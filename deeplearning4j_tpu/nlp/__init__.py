@@ -3,4 +3,6 @@ deeplearning4j-nlp-parent, SURVEY.md §2.5)."""
 from .glove import Glove
 from .paragraph_vectors import LabelsSource, ParagraphVectors
 from .serializer import WordVectorSerializer
+from .vectorizers import (ENGLISH_STOP_WORDS, BagOfWordsVectorizer,
+                          CnnSentenceDataSetIterator, TfidfVectorizer)
 from .word2vec import Word2Vec, WordVectors

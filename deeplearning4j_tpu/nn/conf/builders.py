@@ -37,9 +37,12 @@ class BackpropType(enum.Enum):
 
 @serde.register
 class OptimizationAlgorithm(enum.Enum):
-    """Reference nn/api/OptimizationAlgorithm. STOCHASTIC_GRADIENT_DESCENT is
-    the production path; LINE_GRADIENT_DESCENT/CONJUGATE_GRADIENT/LBFGS are
-    implemented in optimize/solvers."""
+    """Reference nn/api/OptimizationAlgorithm. STOCHASTIC_GRADIENT_DESCENT
+    is the production path (the jitted train step behind fit());
+    LINE_GRADIENT_DESCENT / CONJUGATE_GRADIENT / LBFGS are full-batch
+    solvers in optimize/solvers.py, run via
+    `solver_for(algorithm).optimize(net, x, y)` or
+    `MultiLayerNetwork.fit_solver(...)`."""
 
     STOCHASTIC_GRADIENT_DESCENT = "sgd"
     LINE_GRADIENT_DESCENT = "line_gradient_descent"

@@ -222,6 +222,17 @@ class MultiLayerNetwork:
                 wrapped.shutdown()
         return self
 
+    def fit_solver(self, x, y, *, max_iterations: int = 100,
+                   tolerance: float = 1e-6, fmask=None, lmask=None) -> float:
+        """Full-batch optimization with the configured non-SGD solver
+        (reference Solver.java:43-60 dispatch; LINE_GRADIENT_DESCENT /
+        CONJUGATE_GRADIENT / LBFGS). Returns the final score."""
+        from ..optimize.solvers import solver_for
+        solver = solver_for(self.conf.optimization_algo,
+                            max_iterations=max_iterations,
+                            tolerance=tolerance)
+        return solver.optimize(self, x, y, fmask, lmask)
+
     # -------------------------------------------------------------- pretrain
     def pretrain(self, data, *, epochs: int = 1, batch_size: int = 32
                  ) -> "MultiLayerNetwork":

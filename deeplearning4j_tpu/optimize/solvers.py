@@ -1,0 +1,207 @@
+"""Second-order / line-search solvers: LineGradientDescent,
+ConjugateGradient, LBFGS.
+
+Reference parity: optimize/Solver.java:43-60 dispatches on
+OptimizationAlgorithm to solvers over BaseOptimizer
+(optimize/solvers/{StochasticGradientDescent,LineGradientDescent,
+ConjugateGradient,LBFGS}.java + BackTrackLineSearch.java). SGD remains
+the production path inside the jitted train step; these batch solvers
+optimize the FULL-BATCH loss like the reference's (which the reference
+itself notes are for small/full-batch problems).
+
+TPU-native redesign: the loss is one jitted scalar function of the FLAT
+parameter vector (utils/params flatten/unflatten); value+gradient come
+from one jitted value_and_grad call per evaluation; direction updates
+(Polak-Ribière beta, the L-BFGS two-loop recursion) are a handful of
+device-side vector ops. Backtracking line search (Armijo) mirrors
+BackTrackLineSearch.java's contract.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils import params as param_utils
+
+
+class _FlatProblem:
+    """Scalar loss over the flat parameter vector of a network."""
+
+    def __init__(self, net, x, y, fmask=None, lmask=None):
+        self.net = net
+        template = net.params_tree
+        x = jnp.asarray(x)
+        y = jnp.asarray(y)
+
+        def loss_flat(flat):
+            params = param_utils.unflatten_params(template, flat)
+            loss, _ = net._loss_pure(params, net.state_tree, x, y, fmask,
+                                     lmask, None, False)
+            return loss
+
+        self.value_and_grad = jax.jit(jax.value_and_grad(loss_flat))
+        # value-only for line-search trials: a trial needs no gradient, so
+        # skipping the backward pass roughly halves per-iteration compute
+        self.value = jax.jit(loss_flat)
+        self.flat0 = param_utils.flatten_params(net.params_tree)
+
+    def commit(self, flat):
+        self.net.params_tree = param_utils.unflatten_params(
+            self.net.params_tree, flat)
+
+
+def backtrack_line_search(value_fn, w, direction, f0, g0, *,
+                          step0: float = 1.0, c1: float = 1e-4,
+                          shrink: float = 0.5,
+                          max_steps: int = 20) -> Tuple[jnp.ndarray, float]:
+    """Armijo backtracking (reference BackTrackLineSearch.java): shrink the
+    step until f(w + a·d) <= f0 + c1·a·gᵀd. `value_fn` is VALUE-ONLY (no
+    backward pass per trial). Returns (new_w, new_f); falls back to the
+    unmoved point when no step satisfies the condition."""
+    slope = float(jnp.vdot(g0, direction))
+    if slope >= 0:  # not a descent direction: flip (reference resets)
+        direction = -direction
+        slope = -slope
+    a = step0
+    for _ in range(max_steps):
+        w_new = w + a * direction
+        f_new = float(value_fn(w_new))
+        if f_new <= f0 + c1 * a * slope:
+            return w_new, f_new
+        a *= shrink
+    return w, f0
+
+
+class BaseSolver:
+    def __init__(self, max_iterations: int = 100, tolerance: float = 1e-6):
+        self.max_iterations = int(max_iterations)
+        self.tolerance = float(tolerance)
+        self.scores: List[float] = []
+
+    def optimize(self, net, x, y, fmask=None, lmask=None) -> float:
+        """Minimize the full-batch score; commits params to the net and
+        returns the final score (reference Solver.optimize())."""
+        net._check_init()
+        prob = _FlatProblem(net, x, y, fmask, lmask)
+        w = prob.flat0
+        f, g = prob.value_and_grad(w)
+        f = float(f)
+        self.scores = [f]
+        state = self._init_state(w, g)
+        for it in range(self.max_iterations):
+            direction, state = self._direction(g, state)
+            w_new, f_new = backtrack_line_search(
+                prob.value, w, direction, f, g)
+            if f - f_new < self.tolerance:
+                w = w_new
+                self.scores.append(f_new)
+                break
+            g_new = prob.value_and_grad(w_new)[1]
+            state = self._post_step(state, w, w_new, g, g_new)
+            w, f, g = w_new, f_new, g_new
+            self.scores.append(f)
+        prob.commit(w)
+        net.score_value = self.scores[-1]
+        return self.scores[-1]
+
+    # hooks ---------------------------------------------------------------
+    def _init_state(self, w, g):
+        return None
+
+    def _direction(self, g, state):
+        raise NotImplementedError
+
+    def _post_step(self, state, w, w_new, g, g_new):
+        return state
+
+
+class LineGradientDescent(BaseSolver):
+    """Steepest descent + line search (reference
+    solvers/LineGradientDescent.java)."""
+
+    def _direction(self, g, state):
+        return -g, state
+
+
+class ConjugateGradient(BaseSolver):
+    """Nonlinear CG, Polak-Ribière with restart (reference
+    solvers/ConjugateGradient.java)."""
+
+    def _init_state(self, w, g):
+        return {"prev_g": g, "prev_d": -g, "first": True}
+
+    def _direction(self, g, state):
+        if state["first"]:
+            d = -g
+        else:
+            pg = state["prev_g"]
+            beta = float(jnp.vdot(g, g - pg) /
+                         jnp.maximum(jnp.vdot(pg, pg), 1e-30))
+            beta = max(0.0, beta)  # PR+ restart
+            d = -g + beta * state["prev_d"]
+        state = {**state, "prev_d": d, "first": False}
+        return d, state
+
+    def _post_step(self, state, w, w_new, g, g_new):
+        return {**state, "prev_g": g}
+
+
+class LBFGS(BaseSolver):
+    """Limited-memory BFGS, two-loop recursion (reference
+    solvers/LBFGS.java; memory m=10 like the reference default)."""
+
+    def __init__(self, max_iterations: int = 100, tolerance: float = 1e-6,
+                 memory: int = 10):
+        super().__init__(max_iterations, tolerance)
+        self.memory = int(memory)
+
+    def _init_state(self, w, g):
+        return {"s": [], "y": []}
+
+    def _direction(self, g, state):
+        s_list, y_list = state["s"], state["y"]
+        q = g
+        alphas = []
+        for s, y in zip(reversed(s_list), reversed(y_list)):
+            rho = 1.0 / float(jnp.maximum(jnp.vdot(y, s), 1e-30))
+            a = rho * float(jnp.vdot(s, q))
+            alphas.append((a, rho))
+            q = q - a * y
+        if y_list:
+            y_last, s_last = y_list[-1], s_list[-1]
+            gamma = float(jnp.vdot(s_last, y_last) /
+                          jnp.maximum(jnp.vdot(y_last, y_last), 1e-30))
+            q = q * gamma
+        for (a, rho), s, y in zip(reversed(alphas), s_list, y_list):
+            b = rho * float(jnp.vdot(y, q))
+            q = q + (a - b) * s
+        return -q, state
+
+    def _post_step(self, state, w, w_new, g, g_new):
+        s = w_new - w
+        y = g_new - g
+        if float(jnp.vdot(s, y)) > 1e-10:  # curvature condition
+            state["s"].append(s)
+            state["y"].append(y)
+            if len(state["s"]) > self.memory:
+                state["s"].pop(0)
+                state["y"].pop(0)
+        return state
+
+
+def solver_for(algorithm, **kw) -> BaseSolver:
+    """Reference Solver.Builder dispatch (optimize/Solver.java:43-60)."""
+    from ..nn.conf.builders import OptimizationAlgorithm as OA
+    table = {
+        OA.LINE_GRADIENT_DESCENT: LineGradientDescent,
+        OA.CONJUGATE_GRADIENT: ConjugateGradient,
+        OA.LBFGS: LBFGS,
+    }
+    if algorithm not in table:
+        raise ValueError(
+            f"{algorithm} has no batch solver (SGD runs inside the jitted "
+            "train step via fit())")
+    return table[algorithm](**kw)

@@ -1,3 +1,4 @@
 """Serving: k-NN REST server (reference
 deeplearning4j-nearestneighbor-server, SURVEY.md §2.11)."""
+from .keras_server import KerasBackendServer
 from .nearest_neighbor import NearestNeighbor, NearestNeighborsServer

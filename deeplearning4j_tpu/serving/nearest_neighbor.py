@@ -12,14 +12,12 @@ stdlib http.server with plain-JSON DTOs (float lists, not base64 java
 NDArrays)."""
 from __future__ import annotations
 
-import json
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import List, Optional
+from typing import Optional
 
 import numpy as np
 
 from ..clustering.vptree import VPTree, knn_brute_force
+from ..utils.http_server import JsonHttpServer
 
 
 class NearestNeighbor:
@@ -49,7 +47,7 @@ class NearestNeighbor:
                 np.stack([p[1] for p in pairs]))
 
 
-class NearestNeighborsServer:
+class NearestNeighborsServer(JsonHttpServer):
     """REST k-NN server (reference NearestNeighborsServer.java).
 
     Endpoints:
@@ -61,74 +59,25 @@ class NearestNeighborsServer:
 
     def __init__(self, points, port: int = 0, metric: str = "euclidean",
                  use_device: bool = True):
+        super().__init__(get_routes={"/health": self._health},
+                         post_routes={"/knn": self._knn}, port=port)
         self.nn = NearestNeighbor(points, metric=metric,
                                   use_device=use_device)
-        self._httpd: Optional[ThreadingHTTPServer] = None
-        self._thread: Optional[threading.Thread] = None
-        self._port = int(port)
 
-    @property
-    def port(self) -> int:
-        return self._httpd.server_address[1] if self._httpd else self._port
+    def _health(self, _):
+        return 200, {"status": "ok",
+                     "corpus": int(self.nn.points.shape[0]),
+                     "dim": int(self.nn.points.shape[1])}
 
-    def start(self) -> "NearestNeighborsServer":
-        nn = self.nn
-
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *args):  # quiet
-                pass
-
-            def _json(self, code: int, obj):
-                body = json.dumps(obj).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-            def do_GET(self):
-                if self.path == "/health":
-                    self._json(200, {"status": "ok",
-                                     "corpus": int(nn.points.shape[0]),
-                                     "dim": int(nn.points.shape[1])})
-                else:
-                    self._json(404, {"error": "unknown path"})
-
-            def do_POST(self):
-                if self.path != "/knn":
-                    self._json(404, {"error": "unknown path"})
-                    return
-                try:
-                    length = int(self.headers.get("Content-Length", 0))
-                    req = json.loads(self.rfile.read(length))
-                    point = np.asarray(req["point"], np.float32)
-                    k = int(req.get("k", 5))
-                    idx, dist = nn.search(point, k)
-                    if point.ndim == 1:
-                        results = [{"index": int(i), "distance": float(d)}
-                                   for i, d in zip(idx, dist)]
-                    else:
-                        results = [[{"index": int(i), "distance": float(d)}
-                                    for i, d in zip(row_i, row_d)]
-                                   for row_i, row_d in zip(idx, dist)]
-                    self._json(200, {"results": results})
-                except Exception as e:  # bad request must not kill server
-                    self._json(400, {"error": str(e)})
-
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", self._port), Handler)
-        self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        daemon=True)
-        self._thread.start()
-        return self
-
-    def stop(self):
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None
-
-    def __enter__(self):
-        return self.start()
-
-    def __exit__(self, *exc):
-        self.stop()
+    def _knn(self, req: dict):
+        point = np.asarray(req["point"], np.float32)
+        k = int(req.get("k", 5))
+        idx, dist = self.nn.search(point, k)
+        if point.ndim == 1:
+            results = [{"index": int(i), "distance": float(d)}
+                       for i, d in zip(idx, dist)]
+        else:
+            results = [[{"index": int(i), "distance": float(d)}
+                        for i, d in zip(row_i, row_d)]
+                       for row_i, row_d in zip(idx, dist)]
+        return 200, {"results": results}

@@ -1,5 +1,6 @@
 """Observability: StatsListener → StatsStorage → static report
 (reference deeplearning4j-ui-parent, SURVEY.md §2.6/§5.5)."""
+from .remote import RemoteStatsStorageRouter, StatsReceiverServer
 from .report import export_json, render_html_report
 from .stats import (FileStatsStorage, InMemoryStatsStorage, StatsListener,
                     StatsStorage, StatsUpdateConfiguration)

@@ -1,0 +1,89 @@
+"""Shared stdlib JSON-HTTP server scaffolding for the serving facades
+(k-NN server, Keras backend server, remote stats receiver) — one place
+for handler/json/start/stop/context-manager mechanics."""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional, Tuple
+
+# route tables: {path: handler(request_dict_or_None) -> (code, obj)}
+Routes = Dict[str, Callable]
+
+
+class JsonHttpServer:
+    """Bind GET/POST route tables; handlers return (status, json_obj).
+    Handler exceptions become 400s (client-visible, server stays up)."""
+
+    def __init__(self, get_routes: Routes, post_routes: Routes,
+                 port: int = 0, host: str = "127.0.0.1"):
+        self._get = dict(get_routes)
+        self._post = dict(post_routes)
+        self._port = int(port)
+        self._host = host
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1] if self._httpd else self._port
+
+    @property
+    def url(self) -> str:
+        return f"http://{self._host}:{self.port}"
+
+    def start(self):
+        get_routes, post_routes = self._get, self._post
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _json(self, code: int, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _dispatch(self, routes, payload):
+                fn = routes.get(self.path)
+                if fn is None:
+                    self._json(404, {"error": "unknown path"})
+                    return
+                try:
+                    self._json(*fn(payload))
+                except Exception as e:  # bad request must not kill server
+                    self._json(400, {"error": str(e)})
+
+            def do_GET(self):
+                self._dispatch(get_routes, None)
+
+            def do_POST(self):
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(n))
+                except Exception as e:
+                    self._json(400, {"error": f"bad JSON: {e}"})
+                    return
+                self._dispatch(post_routes, payload)
+
+        self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

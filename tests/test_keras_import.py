@@ -145,3 +145,40 @@ class TestGraphImport:
         out = graph.output(expected["mlp_x"])
         np.testing.assert_allclose(out, expected["mlp_y"], rtol=1e-4,
                                    atol=1e-5)
+
+
+class TestKerasBackendServer:
+    def test_fit_and_predict_over_http(self, expected):
+        """The deeplearning4j-keras role (py4j Server.java): ship an h5,
+        train server-side, predict through the returned handle."""
+        import json
+        import urllib.request
+        from deeplearning4j_tpu.serving import KerasBackendServer
+        x = expected["mlp_x"].tolist()
+        y = np.eye(3)[np.arange(len(x)) % 3].tolist()
+        with KerasBackendServer() as srv:
+            base = f"http://127.0.0.1:{srv.port}"
+            req = urllib.request.Request(
+                base + "/fit",
+                data=json.dumps({"model_path": _h5("mlp"), "features": x,
+                                 "labels": y, "epochs": 5,
+                                 "batch_size": 5}).encode())
+            r = json.loads(urllib.request.urlopen(req, timeout=60).read())
+            assert "handle" in r and np.isfinite(r["score"])
+            req2 = urllib.request.Request(
+                base + "/predict",
+                data=json.dumps({"handle": r["handle"],
+                                 "features": x}).encode())
+            r2 = json.loads(urllib.request.urlopen(req2, timeout=60).read())
+            preds = np.asarray(r2["predictions"])
+            assert preds.shape == (len(x), 3)
+            np.testing.assert_allclose(preds.sum(1), 1.0, rtol=1e-5)
+            # bad handle errors cleanly
+            bad = urllib.request.Request(
+                base + "/predict",
+                data=json.dumps({"handle": "nope", "features": x}).encode())
+            try:
+                urllib.request.urlopen(bad, timeout=30)
+                assert False
+            except urllib.error.HTTPError as e:
+                assert e.code == 400

@@ -264,3 +264,92 @@ class TestWordVectorSerializer:
         back = S.load_google_model(p, binary=False)
         np.testing.assert_allclose(back.get_word_vector_matrix(),
                                    wv.get_word_vector_matrix(), rtol=1e-6)
+
+
+class TestVectorizers:
+    DOCS = ["the cat sat on the mat",
+            "the dog ate my homework",
+            "cats and dogs are animals",
+            "homework is due tomorrow"]
+
+    def test_bag_of_words(self):
+        from deeplearning4j_tpu.nlp import BagOfWordsVectorizer
+        v = BagOfWordsVectorizer().fit(self.DOCS)
+        row = v.transform("the cat and the dog")
+        assert row[v.vocab.index_of("the")] == 2.0
+        assert row[v.vocab.index_of("cat")] == 1.0
+        assert row.sum() == 5.0
+        ds = v.vectorize("cat cat", 1, 3)
+        assert ds.labels.tolist() == [[0.0, 1.0, 0.0]]
+        assert ds.features[0, v.vocab.index_of("cat")] == 2.0
+
+    def test_stop_words_filtered(self):
+        from deeplearning4j_tpu.nlp import (BagOfWordsVectorizer,
+                                            ENGLISH_STOP_WORDS)
+        v = BagOfWordsVectorizer(stop_words=ENGLISH_STOP_WORDS).fit(self.DOCS)
+        assert v.vocab.index_of("the") == -1
+        assert v.vocab.index_of("cat") >= 0
+
+    def test_tfidf_downweights_common_terms(self):
+        from deeplearning4j_tpu.nlp import TfidfVectorizer
+        v = TfidfVectorizer().fit(self.DOCS)
+        row = v.transform("the cat")
+        # 'the' appears in 2 docs, 'cat' in 1 → idf(cat) > idf(the); same
+        # tf here so the tf-idf ordering follows idf
+        assert row[v.vocab.index_of("cat")] > row[v.vocab.index_of("the")]
+
+    def test_cnn_sentence_iterator_trains(self):
+        from deeplearning4j_tpu.nlp import (CnnSentenceDataSetIterator,
+                                            Word2Vec)
+        from deeplearning4j_tpu import (GlobalPoolingLayer, InputType,
+                                        MultiLayerNetwork,
+                                        NeuralNetConfiguration, OutputLayer,
+                                        Adam, PoolingType)
+        corpus = two_topic_corpus(n=120, seed=5)
+        w2v = (Word2Vec.builder().iterate(corpus).layer_size(16)
+               .window_size(3).epochs(30).learning_rate(0.1)
+               .negative_sample(5).use_hierarchic_softmax(False).seed(2)
+               .build().fit())
+        data = [(s, "animal" if i % 2 == 0 else "food")
+                for i, s in enumerate(corpus)]
+        it = CnnSentenceDataSetIterator(w2v, data, ["animal", "food"],
+                                        batch_size=24)
+        b = next(iter(it))
+        assert b.features.shape == (24, 6, 16)
+        assert b.features_mask.shape == (24, 6)
+        conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(0.01))
+                .list()
+                .layer(GlobalPoolingLayer(pooling_type=PoolingType.AVG))
+                .layer(OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.recurrent(16)).build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit(it, epochs=60)
+        ev = net.evaluate(it)
+        assert ev.accuracy() > 0.9, ev.accuracy()
+
+
+class TestNode2Vec:
+    def test_biased_walks_and_embedding(self):
+        from deeplearning4j_tpu.graph import Graph, Node2Vec, Node2VecWalker
+        import numpy as _np
+        rng = _np.random.default_rng(8)
+        g = Graph(20)
+        for base in (0, 10):
+            for i in range(10):
+                for j in range(i + 1, 10):
+                    if rng.random() < 0.7:
+                        g.add_edge(base + i, base + j)
+        g.add_edge(0, 10)
+        walker = Node2VecWalker(g, p=0.5, q=2.0, walk_length=12, seed=1)
+        walks = walker.generate(2)
+        assert len(walks) == 40
+        for w in walks[:5]:
+            for a, b in zip(w, w[1:]):
+                assert b in g.neighbors(a) or a == b
+        n2v = Node2Vec(p=0.5, q=2.0, vector_size=16, window_size=4,
+                       learning_rate=0.05, seed=3)
+        n2v.fit(g, walk_length=16, walks_per_vertex=6, epochs=5)
+        same = _np.mean([n2v.similarity(1, j) for j in range(2, 8)])
+        cross = _np.mean([n2v.similarity(1, 12 + j) for j in range(6)])
+        assert same > cross, (same, cross)

@@ -117,3 +117,33 @@ def test_report_escapes_script_terminator(tmp_path):
     end = text.index("</script>", start)
     data = json.loads(text[start:end])
     assert data["updates"][0]["score"] == 1.0
+
+
+class TestRemoteStats:
+    def test_router_posts_to_receiver(self):
+        """Worker-side router → HTTP → chief-side storage (reference
+        RemoteUIStatsStorageRouter + RemoteReceiverModule round trip),
+        driven by a real training run."""
+        from deeplearning4j_tpu.ui import (InMemoryStatsStorage,
+                                           RemoteStatsStorageRouter,
+                                           StatsListener,
+                                           StatsReceiverServer)
+        central = InMemoryStatsStorage()
+        with StatsReceiverServer(central) as recv:
+            router = RemoteStatsStorageRouter(recv.url)
+            _train(router)
+            router.flush()
+            router.shutdown()
+        assert central.list_session_ids() == ["test-session"]
+        ups = [u for u in central.get_updates("test-session")
+               if "epoch_end" not in u]
+        assert len(ups) >= 6
+        assert np.isfinite(ups[-1]["score"])
+        assert router.dropped == 0
+
+    def test_router_is_write_only(self):
+        from deeplearning4j_tpu.ui import RemoteStatsStorageRouter
+        router = RemoteStatsStorageRouter("http://127.0.0.1:9/x")
+        with pytest.raises(NotImplementedError):
+            router.list_session_ids()
+        router.shutdown()
