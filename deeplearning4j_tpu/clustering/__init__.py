@@ -1,5 +1,6 @@
 """Clustering / spatial algorithms (reference deeplearning4j-core
 clustering/ + plot/, SURVEY.md §2.2)."""
+from .kdtree import KDTree
 from .kmeans import KMeansClustering
 from .tsne import Tsne
 from .vptree import VPTree, knn_brute_force

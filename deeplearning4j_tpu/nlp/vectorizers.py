@@ -18,13 +18,9 @@ from .tokenization import DefaultTokenizerFactory, TokenizerFactory
 from .vocab import VocabCache
 from .word2vec import WordVectors
 
-# The reference ships a stopword list resource (text/stopwords); a compact
-# English core set serves the same role offline.
-ENGLISH_STOP_WORDS = frozenset("""
-a an and are as at be but by for from has have he her his i if in into is
-it its me my no not of on or our she so that the their them they this to
-was we were what when which who will with you your
-""".split())
+# One stop-word list for the whole package (text/stopwords role) — the
+# tokenization module owns it; this alias keeps the vectorizer-side name.
+from .tokenization import STOP_WORDS as ENGLISH_STOP_WORDS  # noqa: E402
 
 
 class BaseTextVectorizer:
@@ -156,16 +152,16 @@ class CnnSentenceDataSetIterator(DataSetIterator):
         mask = np.zeros((B, T), np.float32)
         y = np.zeros((B, len(self.labels)), np.float32)
         for b, (text, label) in enumerate(chunk):
-            toks = self.tf.create(text).get_tokens()[:T]
-            t_out = 0
-            for tok in toks:
-                v = self.wv.word_vector(tok)
-                if v is None:
-                    continue  # reference skips OOV words
+            # Filter OOV FIRST, then truncate (reference
+            # CnnSentenceDataSetIterator removes unknown words before
+            # applying maxSentenceLength).
+            vecs = [v for v in (self.wv.word_vector(tok) for tok in
+                                self.tf.create(text).get_tokens())
+                    if v is not None][:T]
+            for t_out, v in enumerate(vecs):
                 x[b, t_out] = v
                 mask[b, t_out] = 1.0
-                t_out += 1
-            if t_out == 0:
+            if not vecs:
                 mask[b, 0] = 1.0  # keep the row alive (all-OOV sentence)
             y[b, self._label_idx[label]] = 1.0
         return DataSet(x, y, mask, None)

@@ -1,0 +1,5 @@
+"""Streaming: NDArray pub/sub + model-serving routes (reference
+dl4j-streaming: Kafka NDArrayPublisher/NDArrayConsumer + Camel
+DL4jServeRouteBuilder, SURVEY.md §2.4)."""
+from .ndarray_stream import (NDArrayConsumer, NDArrayPublisher,
+                             NDArrayStreamServer, NDArrayTopic, ServeRoute)

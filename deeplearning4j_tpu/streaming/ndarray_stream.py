@@ -1,0 +1,186 @@
+"""NDArray pub/sub streaming + serve routes.
+
+Reference parity: dl4j-streaming's Kafka pipeline —
+streaming/kafka/{NDArrayPublisher,NDArrayConsumer,NDArrayKafkaClient}
+(byte-serialized NDArrays through topics) and
+streaming/routes/DL4jServeRouteBuilder.java (consume a topic, run the
+model, publish predictions).
+
+TPU-native redesign: Kafka/Camel are infrastructure choices, not
+behavior; the behavioral surface (named topics, non-blocking publish,
+blocking consume, a serve route wiring a model between topics) is kept
+over an in-process broker with an optional stdlib-HTTP transport for
+cross-process use. Arrays ride as JSON (shape + flat values) — the
+base64-NDArray DTO role."""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..utils.http_server import JsonHttpServer
+
+
+def _encode(arr: np.ndarray) -> dict:
+    arr = np.asarray(arr, np.float32)
+    return {"shape": list(arr.shape), "data": arr.reshape(-1).tolist()}
+
+
+def _decode(obj: dict) -> np.ndarray:
+    return np.asarray(obj["data"], np.float32).reshape(obj["shape"])
+
+
+class NDArrayTopic:
+    """One named topic: fan-out to every subscriber queue (the Kafka
+    topic/consumer-group role, single-partition semantics)."""
+
+    def __init__(self, name: str, queue_size: int = 256):
+        self.name = name
+        self._queue_size = queue_size
+        self._subscribers: List["queue.Queue"] = []
+        self._lock = threading.Lock()
+
+    def subscribe(self) -> "queue.Queue":
+        q: "queue.Queue" = queue.Queue(maxsize=self._queue_size)
+        with self._lock:
+            self._subscribers.append(q)
+        return q
+
+    def publish(self, arr: np.ndarray) -> None:
+        arr = np.asarray(arr, np.float32)
+        with self._lock:
+            subs = list(self._subscribers)
+        for q in subs:
+            try:
+                q.put_nowait(arr)
+            except queue.Full:
+                pass  # slow consumer drops, publisher never blocks
+
+
+class _Broker:
+    def __init__(self):
+        self._topics: Dict[str, NDArrayTopic] = {}
+        self._lock = threading.Lock()
+
+    def topic(self, name: str) -> NDArrayTopic:
+        with self._lock:
+            t = self._topics.get(name)
+            if t is None:
+                t = self._topics[name] = NDArrayTopic(name)
+            return t
+
+
+_default_broker = _Broker()
+
+
+class NDArrayPublisher:
+    """Reference kafka/NDArrayPublisher: publish(arr) onto a topic."""
+
+    def __init__(self, topic: str, broker: Optional[_Broker] = None):
+        self._topic = (broker or _default_broker).topic(topic)
+
+    def publish(self, arr) -> None:
+        self._topic.publish(np.asarray(arr, np.float32))
+
+
+class NDArrayConsumer:
+    """Reference kafka/NDArrayConsumer: blocking getArrays()."""
+
+    def __init__(self, topic: str, broker: Optional[_Broker] = None):
+        self._queue = (broker or _default_broker).topic(topic).subscribe()
+
+    def get(self, timeout: Optional[float] = None) -> np.ndarray:
+        return self._queue.get(timeout=timeout)
+
+    def poll(self) -> Optional[np.ndarray]:
+        try:
+            return self._queue.get_nowait()
+        except queue.Empty:
+            return None
+
+
+class ServeRoute:
+    """Reference streaming/routes/DL4jServeRouteBuilder: consume arrays
+    from `input_topic`, run the model, publish predictions to
+    `output_topic` — on a background thread until stop()."""
+
+    def __init__(self, model, input_topic: str, output_topic: str,
+                 broker: Optional[_Broker] = None):
+        self.model = model
+        self._consumer = NDArrayConsumer(input_topic, broker)
+        self._publisher = NDArrayPublisher(output_topic, broker)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.served = 0
+        self.errors = 0
+
+    def start(self) -> "ServeRoute":
+        import logging
+        log = logging.getLogger(__name__)
+
+        def run():
+            while not self._stop.is_set():
+                try:
+                    arr = self._consumer.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+                try:
+                    self._publisher.publish(self.model.output(arr))
+                    self.served += 1
+                except Exception:  # one bad input must not kill the route
+                    self.errors += 1
+                    log.exception("ServeRoute: dropping bad input of shape "
+                                  "%s", np.shape(arr))
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class NDArrayStreamServer(JsonHttpServer):
+    """Cross-process transport: POST /publish {topic, shape, data};
+    POST /consume {topic, timeout} (long-poll; registers the caller's
+    subscription on first consume)."""
+
+    def __init__(self, port: int = 0, broker: Optional[_Broker] = None):
+        super().__init__(get_routes={"/health": self._health},
+                         post_routes={"/publish": self._publish,
+                                      "/consume": self._consume}, port=port)
+        self._broker = broker or _Broker()
+        self._consumers: Dict[str, "queue.Queue"] = {}
+        self._lock = threading.Lock()
+
+    def _health(self, _):
+        return 200, {"status": "ok"}
+
+    def _publish(self, req: dict):
+        self._broker.topic(req["topic"]).publish(_decode(req))
+        return 200, {"ok": True}
+
+    def _consume(self, req: dict):
+        # Subscriptions key on (topic, client) so DISTINCT remote clients
+        # each get full fan-out, matching in-process NDArrayConsumer
+        # semantics; pass a stable "client" id per consumer process.
+        key = (req["topic"], str(req.get("client", "default")))
+        with self._lock:
+            q = self._consumers.get(key)
+            if q is None:
+                q = self._broker.topic(key[0]).subscribe()
+                self._consumers[key] = q
+        try:
+            arr = q.get(timeout=float(req.get("timeout", 5.0)))
+        except queue.Empty:
+            return 200, {"empty": True}
+        return 200, {"empty": False, **_encode(arr)}

@@ -1,0 +1,132 @@
+"""Time-series / sequence utilities.
+
+Reference parity: util/TimeSeriesUtils.java (3d↔2d reshapes, time
+reversal incl. masked variants, moving average), util/
+MovingWindowMatrix.java (sliding sub-matrices), util/Viterbi.java
+(most-likely hidden state sequence).
+
+TPU-native note: Viterbi runs as a jitted lax.scan (max-product forward
+pass + host backtrace) — sequence decoding shaped for the accelerator,
+not a Python loop over timesteps.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# --------------------------------------------------------- TimeSeriesUtils
+def reshape_3d_to_2d(arr) -> np.ndarray:
+    """[batch, time, features] → [batch*time, features] (reference
+    TimeSeriesUtils.reshape3dTo2d; NHWC-era layout, time-major rows)."""
+    arr = np.asarray(arr)
+    if arr.ndim != 3:
+        raise ValueError(f"need rank 3, got {arr.shape}")
+    return arr.reshape(-1, arr.shape[-1])
+
+def reshape_2d_to_3d(arr, batch: int) -> np.ndarray:
+    """Inverse of reshape_3d_to_2d (reference reshape2dTo3d)."""
+    arr = np.asarray(arr)
+    if arr.shape[0] % batch:
+        raise ValueError(f"{arr.shape[0]} rows not divisible by batch "
+                         f"{batch}")
+    return arr.reshape(batch, arr.shape[0] // batch, arr.shape[-1])
+
+
+def reverse_time_series(arr, mask=None) -> np.ndarray:
+    """Reverse along time; with a [batch, time] mask, only the VALID
+    prefix of each row reverses and padding stays in place (reference
+    reverseTimeSeries(INDArray, mask))."""
+    arr = np.asarray(arr)
+    if mask is None:
+        return arr[:, ::-1].copy()
+    mask = np.asarray(mask)
+    out = arr.copy()
+    for b in range(arr.shape[0]):
+        n = int(mask[b].sum())
+        out[b, :n] = arr[b, :n][::-1]
+    return out
+
+
+def moving_average(arr, window: int) -> np.ndarray:
+    """Trailing moving average over the last axis (reference
+    TimeSeriesUtils.movingAverage): output length T - window + 1."""
+    arr = np.asarray(arr, np.float64)
+    if window < 1 or window > arr.shape[-1]:
+        raise ValueError(f"window {window} out of range for {arr.shape}")
+    c = np.cumsum(np.concatenate(
+        [np.zeros(arr.shape[:-1] + (1,)), arr], axis=-1), axis=-1)
+    return (c[..., window:] - c[..., :-window]) / window
+
+
+def moving_window_matrix(matrix, window_rows: int,
+                         add_rotate: bool = False) -> np.ndarray:
+    """All vertical sliding windows of a 2-D matrix → [n_windows,
+    window_rows, cols] (reference MovingWindowMatrix.windows();
+    add_rotate appends the row-rotated variants like addRotate)."""
+    m = np.asarray(matrix)
+    if m.ndim != 2:
+        raise ValueError("need a 2-D matrix")
+    n = m.shape[0] - window_rows + 1
+    if n <= 0:
+        raise ValueError(f"window_rows {window_rows} > rows {m.shape[0]}")
+    wins = np.stack([m[i:i + window_rows] for i in range(n)])
+    if add_rotate:
+        wins = np.concatenate([wins, np.stack(
+            [np.roll(w, -1, axis=0) for w in wins])])
+    return wins
+
+
+# ------------------------------------------------------------------ Viterbi
+@functools.partial(jax.jit, static_argnames=())
+def _viterbi_forward(log_init, log_trans, log_emit):
+    """Max-product forward pass: returns (best path scores [T, S],
+    argmax backpointers [T, S])."""
+
+    def step(prev_scores, emit_t):
+        cand = prev_scores[:, None] + log_trans  # [S, S] from→to
+        best_prev = jnp.argmax(cand, axis=0)
+        scores = jnp.max(cand, axis=0) + emit_t
+        return scores, (scores, best_prev)
+
+    first = log_init + log_emit[0]
+    _, (scores, back) = jax.lax.scan(step, first, log_emit[1:])
+    scores = jnp.concatenate([first[None], scores])
+    return scores, back
+
+
+class Viterbi:
+    """Most-likely hidden state sequence (reference util/Viterbi.java,
+    generalized from its binary-state decoder to any HMM):
+    decode(observations) over (initial, transition, emission) log-probs."""
+
+    def __init__(self, initial, transition, emission):
+        """initial [S], transition [S, S] (row from→to), emission [S, O] —
+        probabilities (normalized per row); stored as logs."""
+        eps = 1e-30
+        self.log_init = jnp.log(jnp.asarray(initial, jnp.float32) + eps)
+        self.log_trans = jnp.log(jnp.asarray(transition, jnp.float32) + eps)
+        self.log_emit = jnp.log(jnp.asarray(emission, jnp.float32) + eps)
+
+    def decode(self, observations) -> Tuple[np.ndarray, float]:
+        """→ (state sequence [T], log-likelihood of the best path)."""
+        obs = np.asarray(observations, np.int64)
+        n_obs = self.log_emit.shape[1]
+        if obs.size and (obs.min() < 0 or obs.max() >= n_obs):
+            # jnp gather would silently CLAMP out-of-range indices
+            raise ValueError(f"observation out of range [0, {n_obs})")
+        emit_seq = self.log_emit.T[obs]  # [T, S]
+        scores, back = _viterbi_forward(self.log_init, self.log_trans,
+                                        jnp.asarray(emit_seq))
+        scores = np.asarray(scores)
+        back = np.asarray(back)
+        T = obs.shape[0]
+        path = np.empty(T, np.int64)
+        path[-1] = int(np.argmax(scores[-1]))
+        for t in range(T - 2, -1, -1):
+            path[t] = back[t, path[t + 1]]
+        return path, float(scores[-1].max())

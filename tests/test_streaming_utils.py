@@ -1,0 +1,134 @@
+"""Streaming pub/sub, KDTree, time-series utils, Viterbi tests."""
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import KDTree
+from deeplearning4j_tpu.streaming import (NDArrayConsumer, NDArrayPublisher,
+                                          NDArrayStreamServer, ServeRoute)
+from deeplearning4j_tpu.utils.timeseries import (Viterbi, moving_average,
+                                                 moving_window_matrix,
+                                                 reshape_2d_to_3d,
+                                                 reshape_3d_to_2d,
+                                                 reverse_time_series)
+
+
+class TestStreaming:
+    def test_pub_sub_fanout(self):
+        pub = NDArrayPublisher("t1")
+        c1, c2 = NDArrayConsumer("t1"), NDArrayConsumer("t1")
+        arr = np.arange(6, dtype=np.float32).reshape(2, 3)
+        pub.publish(arr)
+        np.testing.assert_array_equal(c1.get(timeout=5), arr)
+        np.testing.assert_array_equal(c2.get(timeout=5), arr)
+        assert c1.poll() is None
+
+    def test_serve_route_runs_model(self):
+        """DL4jServeRouteBuilder role: input topic → model → output
+        topic."""
+        from deeplearning4j_tpu import (Adam, DenseLayer, InputType,
+                                        MultiLayerNetwork,
+                                        NeuralNetConfiguration, OutputLayer)
+        conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(0.01))
+                .list()
+                .layer(DenseLayer(n_out=8, activation="relu"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        pub = NDArrayPublisher("serve-in")
+        out = NDArrayConsumer("serve-out")
+        with ServeRoute(net, "serve-in", "serve-out"):
+            x = np.random.default_rng(0).standard_normal(
+                (5, 4)).astype(np.float32)
+            pub.publish(x)
+            preds = out.get(timeout=30)
+        assert preds.shape == (5, 3)
+        np.testing.assert_allclose(preds, net.output(x), rtol=1e-5)
+
+    def test_http_transport_round_trip(self):
+        with NDArrayStreamServer() as srv:
+            base = f"http://127.0.0.1:{srv.port}"
+
+            def post(path, obj):
+                req = urllib.request.Request(
+                    base + path, data=json.dumps(obj).encode())
+                return json.loads(urllib.request.urlopen(
+                    req, timeout=30).read())
+
+            # subscribe first (consume with tiny timeout), then publish
+            assert post("/consume", {"topic": "a", "timeout": 0.05})["empty"]
+            arr = np.array([[1.5, 2.5]], np.float32)
+            post("/publish", {"topic": "a", "shape": [1, 2],
+                              "data": [1.5, 2.5]})
+            got = post("/consume", {"topic": "a", "timeout": 5})
+            assert not got["empty"]
+            np.testing.assert_allclose(
+                np.asarray(got["data"]).reshape(got["shape"]), arr)
+
+
+class TestKDTree:
+    def test_matches_brute_force(self):
+        rng = np.random.default_rng(0)
+        pts = rng.standard_normal((400, 6))
+        tree = KDTree(pts)
+        for _ in range(5):
+            q = rng.standard_normal(6)
+            idx, dist = tree.knn(q, 8)
+            brute = np.argsort(np.linalg.norm(pts - q, axis=1))[:8]
+            np.testing.assert_array_equal(np.sort(idx), np.sort(brute))
+            assert np.all(np.diff(dist) >= -1e-12)
+        i, d = tree.nn(pts[137] + 1e-9)
+        assert i == 137
+
+
+class TestTimeSeriesUtils:
+    def test_reshape_roundtrip(self):
+        x = np.arange(24).reshape(2, 3, 4)
+        flat = reshape_3d_to_2d(x)
+        assert flat.shape == (6, 4)
+        np.testing.assert_array_equal(reshape_2d_to_3d(flat, 2), x)
+
+    def test_reverse_with_mask(self):
+        x = np.array([[[1], [2], [3], [0]],
+                      [[4], [5], [6], [7]]], np.float32)
+        mask = np.array([[1, 1, 1, 0], [1, 1, 1, 1]], np.float32)
+        out = reverse_time_series(x, mask)
+        np.testing.assert_array_equal(out[0, :, 0], [3, 2, 1, 0])
+        np.testing.assert_array_equal(out[1, :, 0], [7, 6, 5, 4])
+
+    def test_moving_average(self):
+        np.testing.assert_allclose(
+            moving_average(np.array([1, 2, 3, 4, 5.0]), 2),
+            [1.5, 2.5, 3.5, 4.5])
+
+    def test_moving_window_matrix(self):
+        m = np.arange(12).reshape(4, 3)
+        w = moving_window_matrix(m, 2)
+        assert w.shape == (3, 2, 3)
+        np.testing.assert_array_equal(w[1], m[1:3])
+        wr = moving_window_matrix(m, 2, add_rotate=True)
+        assert wr.shape == (6, 2, 3)
+        np.testing.assert_array_equal(wr[3], m[0:2][::-1])
+
+
+class TestViterbi:
+    def test_classic_hmm_fixture(self):
+        """The standard wikipedia Healthy/Fever fixture: observations
+        [normal, cold, dizzy] decode to [Healthy, Healthy, Fever]."""
+        v = Viterbi(initial=[0.6, 0.4],
+                    transition=[[0.7, 0.3], [0.4, 0.6]],
+                    emission=[[0.5, 0.4, 0.1], [0.1, 0.3, 0.6]])
+        path, ll = v.decode([0, 1, 2])
+        np.testing.assert_array_equal(path, [0, 0, 1])
+        assert ll == pytest.approx(np.log(0.6 * 0.5 * 0.7 * 0.4 * 0.3 * 0.6),
+                                   rel=1e-5)
+
+    def test_deterministic_chain(self):
+        v = Viterbi(initial=[1.0, 0.0],
+                    transition=[[0.0, 1.0], [1.0, 0.0]],
+                    emission=[[1.0, 0.0], [0.0, 1.0]])
+        path, _ = v.decode([0, 1, 0, 1])
+        np.testing.assert_array_equal(path, [0, 1, 0, 1])
