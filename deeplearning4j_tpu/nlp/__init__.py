@@ -2,6 +2,7 @@
 deeplearning4j-nlp-parent, SURVEY.md §2.5)."""
 from .glove import Glove
 from .paragraph_vectors import LabelsSource, ParagraphVectors
+from .sequence_vectors import SequenceVectors
 from .serializer import WordVectorSerializer
 from .vectorizers import (ENGLISH_STOP_WORDS, BagOfWordsVectorizer,
                           CnnSentenceDataSetIterator, TfidfVectorizer)

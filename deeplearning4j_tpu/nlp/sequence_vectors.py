@@ -1,0 +1,86 @@
+"""SequenceVectors: the generic embedding engine over any element type.
+
+Reference parity: models/sequencevectors/SequenceVectors.java:187-310 —
+the generic trainer over `Sequence<T extends SequenceElement>` that
+Word2Vec, ParagraphVectors, and DeepWalk all specialize. Here the device
+kernels (nlp/embeddings.py) already operate on integer ids, so
+genericity is an ID-MAPPING concern: this facade accepts sequences of
+ARBITRARY hashable elements, builds the frequency vocab + huffman tree,
+and trains skip-gram/CBOW with NS and/or HS. Word2Vec remains the
+string-tokenized specialization; DeepWalk the vertex one.
+"""
+from __future__ import annotations
+
+from typing import Hashable, List, Optional, Sequence
+
+import numpy as np
+
+from .embeddings import BatchedEmbeddingTrainer
+from .vocab import VocabCache, build_huffman
+from .word2vec import WordVectors
+
+
+class SequenceVectors(WordVectors):
+    """Builder-configured generic embedding trainer (reference
+    SequenceVectors.Builder surface)."""
+
+    def __init__(self, layer_size: int = 100, window_size: int = 5,
+                 negative: int = 0, use_hierarchic_softmax: bool = True,
+                 cbow: bool = False, learning_rate: float = 0.025,
+                 min_learning_rate: float = 1e-4, batch_size: int = 1024,
+                 min_element_frequency: int = 1, epochs: int = 1,
+                 seed: int = 42):
+        self.layer_size = int(layer_size)
+        self.window_size = int(window_size)
+        self.negative = int(negative)
+        self.use_hierarchic_softmax = bool(use_hierarchic_softmax)
+        self.cbow = bool(cbow)
+        self.learning_rate = float(learning_rate)
+        self.min_learning_rate = float(min_learning_rate)
+        self.batch_size = int(batch_size)
+        self.min_element_frequency = int(min_element_frequency)
+        self.epochs = int(epochs)
+        self.seed = int(seed)
+        self._trainer: Optional[BatchedEmbeddingTrainer] = None
+        self.vocab: Optional[VocabCache] = None
+        self._vectors = None
+        self._normed = None
+        self._key_of = repr  # element → vocab key
+
+    def fit(self, sequences: Sequence[Sequence[Hashable]]
+            ) -> "SequenceVectors":
+        """Train on sequences of arbitrary hashable elements (reference
+        fit(): vocab scan then training passes)."""
+        seqs = [list(s) for s in sequences]
+        cache = VocabCache()
+        for s in seqs:
+            for el in s:
+                cache.add_token(self._key_of(el))
+        cache.finish(min_word_frequency=self.min_element_frequency)
+        build_huffman(cache)
+        self.vocab = cache
+        self._trainer = BatchedEmbeddingTrainer(
+            cache, layer_size=self.layer_size, window=self.window_size,
+            negative=self.negative,
+            use_hierarchic_softmax=self.use_hierarchic_softmax,
+            cbow=self.cbow, learning_rate=self.learning_rate,
+            min_learning_rate=self.min_learning_rate,
+            batch_size=self.batch_size, seed=self.seed)
+        indexed: List[np.ndarray] = []
+        for s in seqs:
+            ids = np.asarray([cache.index_of(self._key_of(el))
+                              for el in s], np.int32)
+            ids = ids[ids >= 0]
+            if len(ids) > 1:
+                indexed.append(ids)
+        self._trainer.fit_sentences(indexed, epochs=self.epochs)
+        self._vectors = self._trainer.vectors()
+        self._normed = None
+        return self
+
+    # element-keyed lookups on top of the WordVectors string API ----------
+    def element_vector(self, element: Hashable) -> Optional[np.ndarray]:
+        return self.word_vector(self._key_of(element))
+
+    def similarity_elements(self, a: Hashable, b: Hashable) -> float:
+        return self.similarity(self._key_of(a), self._key_of(b))

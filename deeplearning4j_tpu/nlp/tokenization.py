@@ -10,10 +10,12 @@ import re
 from typing import Callable, List, Optional
 
 # Subset of the reference's stopwords list (text/stopwords; the reference
-# ships a file — a compact built-in default serves the same role).
-STOP_WORDS = frozenset("""a an and are as at be but by for if in into is it
-no not of on or such that the their then there these they this to was will
-with""".split())
+# ships a file — a compact built-in default serves the same role). One
+# owner for the whole package; nlp.ENGLISH_STOP_WORDS aliases this.
+STOP_WORDS = frozenset("""a an and are as at be but by for from has have he
+her his i if in into is it its me my no not of on or our she so such that
+the their them then there these they this to was we were what when which
+who will with you your""".split())
 
 
 class TokenPreProcess:

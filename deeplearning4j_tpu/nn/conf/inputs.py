@@ -190,6 +190,48 @@ class CnnToRnnPreProcessor(InputPreProcessor):
 
 @serde.register
 @dataclass
+class RnnToCnnPreProcessor(InputPreProcessor):
+    """[batch, time, h*w*c] → [batch*time, h, w, c] (reference
+    nn/conf/preprocessor/RnnToCnnPreProcessor: per-timestep frames flow
+    through conv layers with time folded into batch)."""
+
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def __call__(self, x):
+        return x.reshape(-1, self.height, self.width, self.channels)
+
+    def output_type(self, input_type):
+        if isinstance(input_type, RecurrentType):
+            expect = self.height * self.width * self.channels
+            if input_type.size != expect:
+                raise ValueError(
+                    f"RnnToCnn: rnn size {input_type.size} != h*w*c "
+                    f"{expect}")
+            return ConvolutionalType(height=self.height, width=self.width,
+                                     channels=self.channels)
+        raise ValueError(f"Expected recurrent input, got {input_type}")
+
+
+@serde.register
+@dataclass
+class UnitVarianceProcessor(InputPreProcessor):
+    """Scale activations to unit variance per feature column over the
+    batch (reference nn/conf/preprocessor/UnitVarianceProcessor)."""
+
+    eps: float = 1e-8
+
+    def __call__(self, x):
+        std = x.std(axis=0, keepdims=True)
+        return x / (std + self.eps)
+
+    def output_type(self, input_type):
+        return input_type
+
+
+@serde.register
+@dataclass
 class ComposableInputPreProcessor(InputPreProcessor):
     processors: list = None
 

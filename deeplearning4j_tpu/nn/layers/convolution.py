@@ -422,13 +422,24 @@ class LocalResponseNormalization(Layer):
     def input_kind(self):
         return "cnn"
 
+    # Pallas fast path toggle (the optional-helper contract, reference
+    # ConvolutionLayer.java:66-77): on TPU the fused VMEM kernel runs;
+    # anywhere it cannot, the lax reference path does.
+    use_pallas: bool = True
+
     def forward(self, params, state, x, *, train=False, rng=None, mask=None):
-        half = self.n // 2
-        sq = x * x
-        window = (1, 1, 1, self.n)
-        pads = ((0, 0), (0, 0), (0, 0), (half, self.n - 1 - half))
-        s = lax.reduce_window(sq, 0.0, lax.add, window, (1, 1, 1, 1), pads)
-        return x / jnp.power(self.k + self.alpha * s, self.beta), state
+        from ...ops import pallas_kernels as pk
+        import jax as _jax
+        # The fallback decision must happen OUTSIDE the traced call: a
+        # try/except here would only see tracers (Pallas failures surface
+        # at jit-compile time), so eligibility = static shape check + a
+        # one-time eager compile probe.
+        if self.use_pallas and pk.lrn_supported(x) and \
+                _jax.default_backend() == "tpu" and \
+                pk.tpu_kernel_available():
+            return pk.lrn(x, self.k, self.alpha, self.beta, self.n), state
+        return pk.lrn_reference(x, self.k, self.alpha, self.beta,
+                                self.n), state
 
 
 @serde.register

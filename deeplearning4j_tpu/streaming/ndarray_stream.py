@@ -48,6 +48,11 @@ class NDArrayTopic:
             self._subscribers.append(q)
         return q
 
+    def unsubscribe(self, q: "queue.Queue") -> None:
+        with self._lock:
+            if q in self._subscribers:
+                self._subscribers.remove(q)
+
     def publish(self, arr: np.ndarray) -> None:
         arr = np.asarray(arr, np.float32)
         with self._lock:
@@ -154,12 +159,18 @@ class NDArrayStreamServer(JsonHttpServer):
     POST /consume {topic, timeout} (long-poll; registers the caller's
     subscription on first consume)."""
 
-    def __init__(self, port: int = 0, broker: Optional[_Broker] = None):
+    def __init__(self, port: int = 0, broker: Optional[_Broker] = None,
+                 subscriber_idle_ttl: float = 300.0):
         super().__init__(get_routes={"/health": self._health},
                          post_routes={"/publish": self._publish,
                                       "/consume": self._consume}, port=port)
-        self._broker = broker or _Broker()
-        self._consumers: Dict[str, "queue.Queue"] = {}
+        # Default to the SHARED broker so in-process publishers/consumers
+        # and remote HTTP clients see the same topics.
+        self._broker = broker or _default_broker
+        # (topic, client) → (queue, last_seen); idle entries evict so
+        # departed clients don't leak permanently-subscribed queues.
+        self._consumers: Dict[tuple, tuple] = {}
+        self._ttl = float(subscriber_idle_ttl)
         self._lock = threading.Lock()
 
     def _health(self, _):
@@ -170,15 +181,24 @@ class NDArrayStreamServer(JsonHttpServer):
         return 200, {"ok": True}
 
     def _consume(self, req: dict):
+        import time
         # Subscriptions key on (topic, client) so DISTINCT remote clients
         # each get full fan-out, matching in-process NDArrayConsumer
         # semantics; pass a stable "client" id per consumer process.
         key = (req["topic"], str(req.get("client", "default")))
+        now = time.time()
         with self._lock:
-            q = self._consumers.get(key)
-            if q is None:
+            # evict subscriptions idle past the TTL (departed clients)
+            for k in [k for k, (_, seen) in self._consumers.items()
+                      if now - seen > self._ttl]:
+                q_dead, _ = self._consumers.pop(k)
+                self._broker.topic(k[0]).unsubscribe(q_dead)
+            ent = self._consumers.get(key)
+            if ent is None:
                 q = self._broker.topic(key[0]).subscribe()
-                self._consumers[key] = q
+            else:
+                q = ent[0]
+            self._consumers[key] = (q, now)
         try:
             arr = q.get(timeout=float(req.get("timeout", 5.0)))
         except queue.Empty:

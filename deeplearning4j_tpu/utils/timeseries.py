@@ -115,8 +115,10 @@ class Viterbi:
     def decode(self, observations) -> Tuple[np.ndarray, float]:
         """→ (state sequence [T], log-likelihood of the best path)."""
         obs = np.asarray(observations, np.int64)
+        if obs.size == 0:
+            return np.empty(0, np.int64), 0.0
         n_obs = self.log_emit.shape[1]
-        if obs.size and (obs.min() < 0 or obs.max() >= n_obs):
+        if obs.min() < 0 or obs.max() >= n_obs:
             # jnp gather would silently CLAMP out-of-range indices
             raise ValueError(f"observation out of range [0, {n_obs})")
         emit_seq = self.log_emit.T[obs]  # [T, S]

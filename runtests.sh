@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# CI entry point (reference §2.12 runtests.sh role): build the optional
+# native ETL library, then run the suite on the virtual 8-device CPU mesh
+# (tests/conftest.py forces the platform), mirroring how the reference's
+# Travis loop ran `mvn clean test` per matrix entry.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+make -C native || echo "native ETL build unavailable; numpy fallbacks"
+
+python -m pytest tests/ -q "$@"

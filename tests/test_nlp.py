@@ -353,3 +353,46 @@ class TestNode2Vec:
         same = _np.mean([n2v.similarity(1, j) for j in range(2, 8)])
         cross = _np.mean([n2v.similarity(1, 12 + j) for j in range(6)])
         assert same > cross, (same, cross)
+
+
+class TestSequenceVectors:
+    def test_generic_elements(self):
+        """The generic Sequence<T> engine (reference SequenceVectors):
+        arbitrary hashable elements — here (kind, id) tuples — embed so
+        that co-occurring elements are similar."""
+        from deeplearning4j_tpu.nlp import SequenceVectors
+        rng = np.random.default_rng(4)
+        group_a = [("item", i) for i in range(5)]
+        group_b = [("user", i) for i in range(5)]
+        seqs = []
+        for i in range(200):
+            pool = group_a if i % 2 == 0 else group_b
+            seqs.append([pool[j] for j in rng.integers(0, 5, 6)])
+        sv = SequenceVectors(layer_size=16, window_size=3, negative=5,
+                             use_hierarchic_softmax=False, epochs=25,
+                             learning_rate=0.1, seed=3).fit(seqs)
+        same = sv.similarity_elements(("item", 0), ("item", 1))
+        cross = sv.similarity_elements(("item", 0), ("user", 1))
+        assert same > cross, (same, cross)
+        assert sv.element_vector(("user", 3)).shape == (16,)
+
+
+class TestNewPreprocessors:
+    def test_rnn_to_cnn(self):
+        from deeplearning4j_tpu.nn.conf.inputs import (InputType,
+                                                       RnnToCnnPreProcessor)
+        p = RnnToCnnPreProcessor(height=4, width=4, channels=2)
+        x = np.arange(2 * 3 * 32, dtype=np.float32).reshape(2, 3, 32)
+        out = p(x)
+        assert out.shape == (6, 4, 4, 2)
+        t = p.output_type(InputType.recurrent(32))
+        assert (t.height, t.width, t.channels) == (4, 4, 2)
+        with pytest.raises(ValueError, match="h\\*w\\*c"):
+            p.output_type(InputType.recurrent(31))
+
+    def test_unit_variance(self):
+        from deeplearning4j_tpu.nn.conf.inputs import UnitVarianceProcessor
+        rng = np.random.default_rng(1)
+        x = rng.normal(0, [1.0, 5.0, 0.2], (200, 3)).astype(np.float32)
+        out = UnitVarianceProcessor()(x)
+        np.testing.assert_allclose(np.asarray(out).std(0), 1.0, atol=1e-2)
