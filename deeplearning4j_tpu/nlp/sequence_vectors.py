@@ -11,12 +11,12 @@ string-tokenized specialization; DeepWalk the vertex one.
 """
 from __future__ import annotations
 
-from typing import Hashable, List, Optional, Sequence
+from typing import Hashable, Optional, Sequence
 
 import numpy as np
 
 from .embeddings import BatchedEmbeddingTrainer
-from .vocab import VocabCache, build_huffman
+from .vocab import VocabCache
 from .word2vec import WordVectors
 
 
@@ -45,19 +45,26 @@ class SequenceVectors(WordVectors):
         self.vocab: Optional[VocabCache] = None
         self._vectors = None
         self._normed = None
-        self._key_of = repr  # element → vocab key
+        self._keys: dict = {}  # element → stable vocab key (by equality)
+
+    def _key_of(self, el: Hashable) -> str:
+        """Stable key via the element's OWN hash/eq (repr would fragment
+        value-equal instances lacking a value-based __repr__)."""
+        key = self._keys.get(el)
+        if key is None:
+            key = self._keys[el] = f"e{len(self._keys)}"
+        return key
 
     def fit(self, sequences: Sequence[Sequence[Hashable]]
             ) -> "SequenceVectors":
         """Train on sequences of arbitrary hashable elements (reference
-        fit(): vocab scan then training passes)."""
-        seqs = [list(s) for s in sequences]
-        cache = VocabCache()
-        for s in seqs:
-            for el in s:
-                cache.add_token(self._key_of(el))
-        cache.finish(min_word_frequency=self.min_element_frequency)
-        build_huffman(cache)
+        fit(): vocab scan then training passes). Reuses the word2vec
+        vocab/indexing helpers over key-mapped token lists."""
+        from .embeddings import sentences_to_indices
+        from .vocab import VocabConstructor
+        token_seqs = [[self._key_of(el) for el in s] for s in sequences]
+        cache = VocabConstructor(
+            min_word_frequency=self.min_element_frequency).build(token_seqs)
         self.vocab = cache
         self._trainer = BatchedEmbeddingTrainer(
             cache, layer_size=self.layer_size, window=self.window_size,
@@ -66,14 +73,8 @@ class SequenceVectors(WordVectors):
             cbow=self.cbow, learning_rate=self.learning_rate,
             min_learning_rate=self.min_learning_rate,
             batch_size=self.batch_size, seed=self.seed)
-        indexed: List[np.ndarray] = []
-        for s in seqs:
-            ids = np.asarray([cache.index_of(self._key_of(el))
-                              for el in s], np.int32)
-            ids = ids[ids >= 0]
-            if len(ids) > 1:
-                indexed.append(ids)
-        self._trainer.fit_sentences(indexed, epochs=self.epochs)
+        self._trainer.fit_sentences(sentences_to_indices(token_seqs, cache),
+                                    epochs=self.epochs)
         self._vectors = self._trainer.vectors()
         self._normed = None
         return self

@@ -200,6 +200,11 @@ class RnnToCnnPreProcessor(InputPreProcessor):
     channels: int = 0
 
     def __call__(self, x):
+        expect = self.height * self.width * self.channels
+        if x.shape[-1] != expect:
+            # without this, any divisible total silently mixes timesteps
+            raise ValueError(f"RnnToCnn: feature size {x.shape[-1]} != "
+                             f"h*w*c {expect}")
         return x.reshape(-1, self.height, self.width, self.channels)
 
     def output_type(self, input_type):
@@ -223,8 +228,11 @@ class UnitVarianceProcessor(InputPreProcessor):
     eps: float = 1e-8
 
     def __call__(self, x):
+        import jax.numpy as jnp
         std = x.std(axis=0, keepdims=True)
-        return x / (std + self.eps)
+        # constant columns (incl. batch size 1) pass through unscaled —
+        # dividing by ~eps would blow activations up by ~1e8
+        return x / jnp.where(std > self.eps, std, 1.0)
 
     def output_type(self, input_type):
         return input_type

@@ -209,9 +209,20 @@ class MultiLayerNetwork:
         wrapped = AsyncDataSetIterator(it, async_queue_size) \
             if (use_async and it.async_supported()) else it
         step = step_fn or self._fit_batch
+        import time as _time
         try:
             for _ in range(epochs):
-                for ds in wrapped:
+                it_epoch = iter(wrapped)
+                while True:
+                    # Track time blocked on the data pipeline (reference
+                    # lastEtlTime, MultiLayerNetwork.java:1063-1065);
+                    # PerformanceListener reports it.
+                    t0 = _time.perf_counter()
+                    try:
+                        ds = next(it_epoch)
+                    except StopIteration:
+                        break
+                    self.last_etl_ms = (_time.perf_counter() - t0) * 1000.0
                     step(ds)
                 self.epoch += 1
                 for lst in self.listeners:

@@ -72,6 +72,9 @@ class PerformanceListener(IterationListener):
                    f"{dt / iters * 1000:.1f} ms/iter")
             if self.report_samples and self._last_batch_size:
                 msg += f", {iters * self._last_batch_size / dt:.1f} samples/sec"
+            etl = getattr(model, "last_etl_ms", None)
+            if etl is not None:
+                msg += f", etl {etl:.2f} ms"
             self._printer(msg)
         self._last_time = now
         self._last_iter = iteration

@@ -199,8 +199,18 @@ class NDArrayStreamServer(JsonHttpServer):
             else:
                 q = ent[0]
             self._consumers[key] = (q, now)
+        # Clamp the wait below the TTL so an ACTIVE long-poll can never be
+        # evicted mid-wait by another client's sweep; refresh last_seen
+        # when the wait ends.
+        wait = min(float(req.get("timeout", 5.0)), self._ttl * 0.5)
         try:
-            arr = q.get(timeout=float(req.get("timeout", 5.0)))
+            arr = q.get(timeout=wait)
         except queue.Empty:
+            arr = None
+        with self._lock:
+            if key in self._consumers:
+                self._consumers[key] = (self._consumers[key][0],
+                                        time.time())
+        if arr is None:
             return 200, {"empty": True}
         return 200, {"empty": False, **_encode(arr)}

@@ -369,10 +369,12 @@ class TestSequenceVectors:
             pool = group_a if i % 2 == 0 else group_b
             seqs.append([pool[j] for j in rng.integers(0, 5, 6)])
         sv = SequenceVectors(layer_size=16, window_size=3, negative=5,
-                             use_hierarchic_softmax=False, epochs=25,
+                             use_hierarchic_softmax=False, epochs=40,
                              learning_rate=0.1, seed=3).fit(seqs)
-        same = sv.similarity_elements(("item", 0), ("item", 1))
-        cross = sv.similarity_elements(("item", 0), ("user", 1))
+        same = np.mean([sv.similarity_elements(("item", a), ("item", b))
+                        for a in range(5) for b in range(a + 1, 5)])
+        cross = np.mean([sv.similarity_elements(("item", a), ("user", b))
+                         for a in range(5) for b in range(5)])
         assert same > cross, (same, cross)
         assert sv.element_vector(("user", 3)).shape == (16,)
 
