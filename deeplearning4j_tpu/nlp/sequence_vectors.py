@@ -47,13 +47,19 @@ class SequenceVectors(WordVectors):
         self._normed = None
         self._keys: dict = {}  # element → stable vocab key (by equality)
 
-    def _key_of(self, el: Hashable) -> str:
-        """Stable key via the element's OWN hash/eq (repr would fragment
-        value-equal instances lacking a value-based __repr__)."""
+    def _intern(self, el: Hashable) -> str:
+        """Assign a stable key via the element's OWN hash/eq (repr would
+        fragment value-equal instances lacking a value-based __repr__).
+        Only fit() interns; lookups stay pure."""
         key = self._keys.get(el)
         if key is None:
             key = self._keys[el] = f"e{len(self._keys)}"
         return key
+
+    def _key_of(self, el: Hashable) -> str:
+        """Pure lookup — unseen elements must NOT grow (and pin into)
+        the key table from the query path."""
+        return self._keys.get(el, "\x00unseen")
 
     def fit(self, sequences: Sequence[Sequence[Hashable]]
             ) -> "SequenceVectors":
@@ -62,7 +68,7 @@ class SequenceVectors(WordVectors):
         vocab/indexing helpers over key-mapped token lists."""
         from .embeddings import sentences_to_indices
         from .vocab import VocabConstructor
-        token_seqs = [[self._key_of(el) for el in s] for s in sequences]
+        token_seqs = [[self._intern(el) for el in s] for s in sequences]
         cache = VocabConstructor(
             min_word_frequency=self.min_element_frequency).build(token_seqs)
         self.vocab = cache
