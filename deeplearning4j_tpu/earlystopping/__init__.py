@@ -16,4 +16,5 @@ from .termination import (BestScoreEpochTerminationCondition,
                           MaxScoreIterationTerminationCondition,
                           MaxTimeIterationTerminationCondition,
                           ScoreImprovementEpochTerminationCondition)
-from .trainer import EarlyStoppingTrainer
+from .trainer import (EarlyStoppingGraphTrainer, EarlyStoppingParallelTrainer,
+                      EarlyStoppingTrainer)

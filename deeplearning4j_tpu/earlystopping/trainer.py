@@ -24,6 +24,12 @@ class EarlyStoppingTrainer:
         self.train_labels = train_labels
         self.batch_size = batch_size
 
+    def _fit_epoch(self):
+        """One training epoch; EarlyStoppingParallelTrainer overrides to
+        route through a ParallelWrapper."""
+        self.model.fit(self.train_data, self.train_labels, epochs=1,
+                       batch_size=self.batch_size)
+
     def fit(self, max_epochs: int = 10_000) -> EarlyStoppingResult:
         conf = self.config
         model = self.model
@@ -65,8 +71,7 @@ class EarlyStoppingTrainer:
         try:
             while epoch < max_epochs:
                 try:
-                    model.fit(self.train_data, self.train_labels, epochs=1,
-                              batch_size=self.batch_size)
+                    self._fit_epoch()
                 except _StopIteration:
                     reason = TerminationReason.ITERATION_TERMINATION
                     details = stop_flag["why"]
@@ -119,3 +124,31 @@ class EarlyStoppingTrainer:
             total_epochs=epoch,
             best_model=best if best is not None else model,
         )
+
+
+class EarlyStoppingGraphTrainer(EarlyStoppingTrainer):
+    """Name parity (reference EarlyStoppingGraphTrainer); the base already
+    handles ComputationGraph."""
+
+
+class EarlyStoppingParallelTrainer(EarlyStoppingTrainer):
+    """Early stopping over data-parallel training (reference
+    parallelism/EarlyStoppingParallelTrainer.java): each epoch trains
+    through the ParallelWrapper's sharded/local-SGD step; termination,
+    scoring, and best-model saving read the wrapped net as usual."""
+
+    def __init__(self, config: EarlyStoppingConfiguration, wrapper,
+                 train_data, train_labels=None, batch_size: int = 32):
+        super().__init__(config, wrapper.model, train_data, train_labels,
+                         batch_size)
+        self.wrapper = wrapper
+
+    def _fit_epoch(self):
+        try:
+            self.wrapper.fit(self.train_data, self.train_labels, epochs=1,
+                             batch_size=self.batch_size)
+        finally:
+            # iteration-termination aborts via exception BEFORE fit's own
+            # finalize; a pending local-SGD window must still average so
+            # the saved/best model honors the wrapper's contract
+            self.wrapper.finalize()

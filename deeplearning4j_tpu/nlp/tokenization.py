@@ -101,3 +101,31 @@ class NGramTokenizerFactory(TokenizerFactory):
             for i in range(len(toks) - n + 1):
                 out.append(" ".join(toks[i:i + n]))
         return Tokenizer(out, self._pre)
+
+
+class CharacterTokenizerFactory(TokenizerFactory):
+    """Character-level tokenizer — the offline stand-in for the
+    reference's CJK submodules (deeplearning4j-nlp-japanese/-korean
+    vendor Kuromoji/KoreanTokenizer; character tokenization is the
+    standard dependency-free baseline for unsegmented scripts)."""
+
+    def __init__(self, keep_whitespace: bool = False):
+        self._pre: Optional[TokenPreProcess] = None
+        self.keep_whitespace = keep_whitespace
+
+    def create(self, text: str) -> Tokenizer:
+        chars = list(text) if self.keep_whitespace else \
+            [c for c in text if not c.isspace()]
+        return Tokenizer(chars, self._pre)
+
+
+class RegexTokenizerFactory(TokenizerFactory):
+    """Tokens = regex matches (reference nlp's PosUimaTokenizer niche of
+    pattern-driven tokenization, without UIMA)."""
+
+    def __init__(self, pattern: str = r"\w+"):
+        self._re = re.compile(pattern)
+        self._pre: Optional[TokenPreProcess] = None
+
+    def create(self, text: str) -> Tokenizer:
+        return Tokenizer(self._re.findall(text), self._pre)

@@ -1,0 +1,116 @@
+"""sklearn-style estimator + parallel early stopping + tokenizer tests."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (Adam, DenseLayer, InputType,
+                                NeuralNetConfiguration, OutputLayer, Sgd)
+from deeplearning4j_tpu.ml import MLNClassifier, MLNRegressor
+
+
+def _clf_conf():
+    return (NeuralNetConfiguration.builder().seed(1).updater(Adam(0.05))
+            .list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+
+
+class TestSklearnEstimators:
+    def test_classifier_fit_predict_score(self):
+        rng = np.random.default_rng(0)
+        X = rng.standard_normal((150, 4)).astype(np.float32)
+        y = np.array([10, 20, 30])[(X[:, 0] > 0).astype(int)
+                                   + (X[:, 1] > 0.5).astype(int)]
+        clf = MLNClassifier(_clf_conf, epochs=40, batch_size=32)
+        clf.fit(X, y)
+        assert clf.score(X, y) > 0.9
+        preds = clf.predict(X[:5])
+        assert set(preds) <= {10, 20, 30}  # original label space
+        proba = clf.predict_proba(X[:5])
+        np.testing.assert_allclose(proba.sum(1), 1.0, rtol=1e-5)
+        # sklearn params contract
+        assert clf.get_params()["epochs"] == 40
+        clf.set_params(epochs=5)
+        assert clf.epochs == 5
+        with pytest.raises(ValueError):
+            clf.set_params(bogus=1)
+
+    def test_regressor_r2(self):
+        rng = np.random.default_rng(1)
+        X = rng.standard_normal((200, 3)).astype(np.float32)
+        y = 2.0 * X[:, 0] - X[:, 1] + 0.1 * rng.standard_normal(200)
+
+        def conf():
+            return (NeuralNetConfiguration.builder().seed(2)
+                    .updater(Adam(0.02)).list()
+                    .layer(DenseLayer(n_out=16, activation="tanh"))
+                    .layer(OutputLayer(n_out=1, activation="identity",
+                                       loss="mse"))
+                    .set_input_type(InputType.feed_forward(3)).build())
+        reg = MLNRegressor(conf, epochs=60, batch_size=50)
+        reg.fit(X, y)
+        assert reg.score(X, y) > 0.9
+        assert reg.predict(X[:7]).shape == (7,)
+
+
+class TestParallelEarlyStopping:
+    def test_early_stopping_over_parallel_wrapper(self):
+        from deeplearning4j_tpu.earlystopping import (
+            EarlyStoppingConfiguration, EarlyStoppingParallelTrainer,
+            InMemoryModelSaver, MaxEpochsTerminationCondition,
+            ScoreImprovementEpochTerminationCondition)
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.parallel import (ParallelWrapper,
+                                                 data_parallel_mesh)
+        net = MultiLayerNetwork(_clf_conf()).init()
+        pw = ParallelWrapper(net, mesh=data_parallel_mesh(4),
+                             averaging_frequency=2)
+        rng = np.random.default_rng(3)
+        X = rng.standard_normal((96, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 96)]
+        conf = EarlyStoppingConfiguration(
+            epoch_termination_conditions=[
+                MaxEpochsTerminationCondition(15),
+                ScoreImprovementEpochTerminationCondition(5)],
+            saver=InMemoryModelSaver())
+        result = EarlyStoppingParallelTrainer(
+            conf, pw, X, y, batch_size=24).fit()
+        assert result.total_epochs <= 15
+        assert result.best_model is not None
+        out = result.best_model.output(X[:4])
+        assert out.shape == (4, 3)
+
+
+class TestExtraTokenizers:
+    def test_character_tokenizer(self):
+        from deeplearning4j_tpu.nlp.tokenization import (
+            CharacterTokenizerFactory)
+        tf = CharacterTokenizerFactory()
+        assert tf.create("日本語 テスト").get_tokens() == \
+            ["日", "本", "語", "テ", "ス", "ト"]
+        tf2 = CharacterTokenizerFactory(keep_whitespace=True)
+        assert " " in tf2.create("a b").get_tokens()
+
+    def test_regex_tokenizer(self):
+        from deeplearning4j_tpu.nlp.tokenization import RegexTokenizerFactory
+        tf = RegexTokenizerFactory(r"[A-Za-z]+")
+        assert tf.create("abc, def! 123 ghi").get_tokens() == \
+            ["abc", "def", "ghi"]
+
+    def test_character_tokenizer_trains_word2vec(self):
+        """Char-level vectors through the standard Word2Vec facade (the
+        CJK-pipeline role end-to-end)."""
+        from deeplearning4j_tpu.nlp import Word2Vec
+        from deeplearning4j_tpu.nlp.tokenization import (
+            CharacterTokenizerFactory)
+        rng = np.random.default_rng(5)
+        docs = ["".join(rng.choice(list("abcde" if i % 2 == 0 else "vwxyz"),
+                                   8)) for i in range(200)]
+        w2v = (Word2Vec.builder().iterate(docs)
+               .tokenizer_factory(CharacterTokenizerFactory())
+               .layer_size(12).window_size(2).epochs(15)
+               .learning_rate(0.1).negative_sample(5)
+               .use_hierarchic_softmax(False).seed(4).build().fit())
+        same = w2v.similarity("a", "b")
+        cross = w2v.similarity("a", "x")
+        assert same > cross, (same, cross)
