@@ -38,6 +38,7 @@ from ..utils import params as param_utils
 from .conf.builders import BackpropType, MultiLayerConfiguration
 from .layers import core as core_layers
 from .updaters import normalize_layer_gradients
+from .stepping import DeviceIterationMixin
 
 Array = jax.Array
 
@@ -58,7 +59,7 @@ def _regularization_score(layers, params) -> Array:
     return total
 
 
-class MultiLayerNetwork:
+class MultiLayerNetwork(DeviceIterationMixin):
     def __init__(self, conf: MultiLayerConfiguration):
         self.conf = conf
         self.layers = list(conf.layers)
@@ -187,6 +188,34 @@ class MultiLayerNetwork:
         # deep-copied at those seams so donation can never kill a shared
         # buffer.
         self._train_step_fn = jax.jit(train_step, donate_argnums=(0, 1, 2))
+
+        # Fused multi-step training (see ComputationGraph._build_jitted):
+        # K optimizer steps per dispatch via lax.scan.
+        def multi_step_stacked(params, opt_state, state, iteration, rng,
+                               s_x, s_y, s_fmask, s_lmask):
+            def body(carry, xs):
+                out = train_step(*carry, *xs)
+                return out[:5], out[5]
+            carry, losses = jax.lax.scan(
+                body, (params, opt_state, state, iteration, rng),
+                (s_x, s_y, s_fmask, s_lmask))
+            return (*carry, losses)
+
+        def multi_step_repeat(params, opt_state, state, iteration, rng,
+                              x, y, fmask, lmask, length):
+            def body(carry, _):
+                out = train_step(*carry, x, y, fmask, lmask)
+                return out[:5], out[5]
+            carry, losses = jax.lax.scan(
+                body, (params, opt_state, state, iteration, rng), None,
+                length=length)
+            return (*carry, losses)
+
+        self._multi_step_stacked_fn = jax.jit(
+            multi_step_stacked, donate_argnums=(0, 1, 2))
+        self._multi_step_repeat_fn = jax.jit(
+            multi_step_repeat, donate_argnums=(0, 1, 2),
+            static_argnums=(9,))
         self._output_fn = jax.jit(
             lambda params, state, x, fmask:
             self._forward_pure(params, state, x, False, None, fmask)[0])
@@ -232,6 +261,66 @@ class MultiLayerNetwork:
             if isinstance(wrapped, AsyncDataSetIterator):
                 wrapped.shutdown()
         return self
+
+    def fit_batches(self, batches: Sequence) -> "MultiLayerNetwork":
+        """K optimizer steps over K same-shaped DataSets in ONE device
+        dispatch (jitted lax.scan; the ComputationGraph.fit_batches
+        analog). Listeners fire per step afterwards."""
+        self._check_init()
+        if self.conf.backprop_type == BackpropType.TRUNCATED_BPTT:
+            raise NotImplementedError(
+                "fit_batches does not support truncated BPTT windows; "
+                "call fit in a loop")
+        packed = [(self._cast_features(b.features), jnp.asarray(b.labels),
+                   None if b.features_mask is None
+                   else jnp.asarray(b.features_mask),
+                   None if b.labels_mask is None
+                   else jnp.asarray(b.labels_mask))
+                  for b in (batches if isinstance(batches, (list, tuple))
+                            else list(batches))]
+        stack = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *packed)
+        self._rnn_carry = None
+        out = self._multi_step_stacked_fn(
+            self.params_tree, self.opt_state, self.state_tree,
+            self._iteration_device(None), self._rng, *stack)
+        self._commit_multi(out, len(packed))
+        return self
+
+    def fit_batch_repeated(self, ds: DataSet, steps: int
+                           ) -> "MultiLayerNetwork":
+        """`steps` optimizer steps on one device-resident minibatch in
+        one dispatch (lax.scan with the batch closed over — not
+        replicated in HBM)."""
+        self._check_init()
+        if self.conf.backprop_type == BackpropType.TRUNCATED_BPTT:
+            raise NotImplementedError(
+                "fit_batch_repeated does not support truncated BPTT")
+        self._rnn_carry = None
+        out = self._multi_step_repeat_fn(
+            self.params_tree, self.opt_state, self.state_tree,
+            self._iteration_device(None), self._rng,
+            self._cast_features(ds.features), jnp.asarray(ds.labels),
+            None if ds.features_mask is None
+            else jnp.asarray(ds.features_mask),
+            None if ds.labels_mask is None
+            else jnp.asarray(ds.labels_mask), int(steps))
+        self._commit_multi(out, int(steps))
+        return self
+
+    def _commit_multi(self, out, steps: int):
+        (self.params_tree, self.opt_state, self.state_tree, it, self._rng,
+         losses) = out
+        self._iteration += steps
+        self._iteration_dev = it
+        self._iteration_dev_mesh = None
+        self.score_value = losses[-1]
+        if self.listeners:
+            for k in range(steps):
+                self.score_value = losses[k]
+                for lst in self.listeners:
+                    lst.iteration_done(
+                        self, self._iteration - steps + k + 1)
+            self.score_value = losses[-1]
 
     def fit_solver(self, x, y, *, max_iterations: int = 100,
                    tolerance: float = 1e-6, fmask=None, lmask=None) -> float:
@@ -361,12 +450,12 @@ class MultiLayerNetwork:
         with (mesh if mesh is not None else contextlib.nullcontext()):
             out = self._train_step_fn(
                 self.params_tree, self.opt_state, self._merged_state(),
-                jnp.asarray(self.iteration, jnp.int32), self._rng,
+                self._iteration_device(mesh), self._rng,
                 x, y, fmask, lmask)
-        (self.params_tree, self.opt_state, new_state, _, self._rng,
+        (self.params_tree, self.opt_state, new_state, new_iter, self._rng,
          loss) = out
         self._commit_state(new_state)
-        self.iteration += 1
+        self._commit_iteration(new_iter, mesh)
         self.score_value = loss
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration)

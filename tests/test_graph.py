@@ -378,3 +378,73 @@ class TestGraphTbptt:
         steps = [g.rnn_time_step(x[:, t])[0] for t in range(6)]
         stepped = np.stack(steps, axis=1)
         np.testing.assert_allclose(stepped, full, rtol=1e-5, atol=1e-6)
+
+
+class TestFusedMultiStep:
+    """fit_batches / fit_batch_repeated (lax.scan fused training loop)
+    must be bit-identical to a loop of single fit_batch dispatches."""
+
+    def _make(self):
+        from deeplearning4j_tpu import (NeuralNetConfiguration, InputType,
+                                        DenseLayer, OutputLayer, Adam)
+        conf = (NeuralNetConfiguration.builder().seed(5).updater(Adam(0.01))
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("dense", DenseLayer(n_out=16, activation="relu"),
+                           "in")
+                .add_layer("out", OutputLayer(n_out=10, activation="softmax",
+                                              loss="mcxent"), "dense")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(8))
+                .build())
+        return ComputationGraph(conf).init()
+
+    def test_fused_multi_step_repeat_matches_loop(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((8, 8)).astype(np.float32)
+        y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 8)]
+        mds = MultiDataSet([x], [y])
+        g1, g2 = self._make(), self._make()
+        for _ in range(4):
+            g1.fit_batch(mds)
+        g2.fit_batch_repeated(mds, 4)
+        assert g1.iteration == g2.iteration == 4
+        for a, b in zip(jax.tree_util.tree_leaves(g1.params_tree),
+                        jax.tree_util.tree_leaves(g2.params_tree)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert float(g1.score_value) == float(g2.score_value)
+
+    def test_fused_multi_step_stacked_matches_loop(self):
+        rng = np.random.default_rng(1)
+        batches = []
+        for _ in range(4):
+            xi = rng.standard_normal((8, 8)).astype(np.float32)
+            yi = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 8)]
+            batches.append(MultiDataSet([xi], [yi]))
+        g1, g2 = self._make(), self._make()
+        for b in batches:
+            g1.fit_batch(b)
+        losses = []
+
+        class Rec:
+            def iteration_done(self, net, it):
+                losses.append((it, float(net.score_value)))
+        g2.listeners.append(Rec())
+        g2.fit_batches(batches)
+        assert [it for it, _ in losses] == [1, 2, 3, 4]
+        for a, b in zip(jax.tree_util.tree_leaves(g1.params_tree),
+                        jax.tree_util.tree_leaves(g2.params_tree)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_iteration_property_resets_device_cache(self):
+        rng = np.random.default_rng(2)
+        x = rng.standard_normal((4, 8)).astype(np.float32)
+        y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 4)]
+        mds = MultiDataSet([x], [y])
+        g = self._make()
+        g.fit_batch(mds)
+        assert g._iteration_dev is not None
+        g.iteration = 100  # e.g. checkpoint restore
+        assert g._iteration_dev is None
+        g.fit_batch(mds)
+        assert g.iteration == 101

@@ -219,3 +219,45 @@ class TestGradientCheck:
                 .set_input_type(InputType.feed_forward(4))
                 .build())
         self._check(conf, data.features, data.labels)
+
+
+class TestFusedMultiStepMLN:
+    """MLN fit_batches / fit_batch_repeated must be bit-identical to a
+    loop of single _fit_batch dispatches (ComputationGraph analog)."""
+
+    def _make(self):
+        conf = (NeuralNetConfiguration.builder().seed(9).updater(Adam(0.01))
+                .list()
+                .layer(DenseLayer(n_out=12, activation="tanh"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(5))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    def test_repeat_matches_loop(self):
+        rng = np.random.default_rng(0)
+        ds = DataSet(rng.standard_normal((8, 5)).astype(np.float32),
+                     np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)])
+        n1, n2 = self._make(), self._make()
+        for _ in range(3):
+            n1._fit_batch(ds)
+        n2.fit_batch_repeated(ds, 3)
+        assert n1.iteration == n2.iteration == 3
+        for a, b in zip(jax.tree_util.tree_leaves(n1.params_tree),
+                        jax.tree_util.tree_leaves(n2.params_tree)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_stacked_matches_loop(self):
+        rng = np.random.default_rng(1)
+        batches = [DataSet(rng.standard_normal((8, 5)).astype(np.float32),
+                           np.eye(3, dtype=np.float32)[
+                               rng.integers(0, 3, 8)])
+                   for _ in range(3)]
+        n1, n2 = self._make(), self._make()
+        for b in batches:
+            n1._fit_batch(b)
+        n2.fit_batches(batches)
+        for a, b in zip(jax.tree_util.tree_leaves(n1.params_tree),
+                        jax.tree_util.tree_leaves(n2.params_tree)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -190,3 +190,111 @@ class TestSerde:
     def test_enum_roundtrip(self):
         w = WeightInit.XAVIER_UNIFORM
         assert serde.from_json(serde.to_json(w)) is w
+
+
+class TestConvAlgoAndBNStats:
+    """Round-3 perf-path regressions: space-to-depth conv equivalence and
+    single-pass (pivoted) BN statistics (docs/perf_resnet50.md)."""
+
+    def _stem_pair(self, C=3, k=7, s=2, mode=None):
+        from deeplearning4j_tpu.nn.layers.convolution import (
+            ConvolutionLayer, ConvolutionMode)
+        mode = mode or ConvolutionMode.TRUNCATE
+        kw = dict(n_in=C, n_out=8, kernel_size=(k, k), stride=(s, s),
+                  convolution_mode=mode)
+        return (ConvolutionLayer(**kw),
+                ConvolutionLayer(conv_algo="direct", **kw))
+
+    def test_space_to_depth_exact_forward_and_grad(self):
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((2, 230, 230, 3)), jnp.float32)
+        s2d, direct = self._stem_pair()
+        p = s2d.init_params(jax.random.PRNGKey(0))
+        assert s2d._use_space_to_depth(
+            x, p["W"], (2, 2), (1, 1), ((0, 0), (0, 0)))
+        y1, _ = s2d.forward(p, {}, x)
+        y2, _ = direct.forward(p, {}, x)
+        assert y1.shape == y2.shape == (2, 112, 112, 8)
+        np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
+                                   atol=2e-5)
+        g1 = jax.grad(lambda w: s2d.forward({**p, "W": w}, {}, x)[0].sum())(
+            p["W"])
+        g2 = jax.grad(lambda w: direct.forward({**p, "W": w}, {}, x)[0]
+                      .sum())(p["W"])
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   rtol=2e-4, atol=2e-3)
+
+    def test_space_to_depth_infeasible_falls_back(self):
+        # odd padded extent (SAME on 224 with k=7 s=2 pads to 229) and
+        # many-channel convs must take the direct path
+        rng = np.random.default_rng(1)
+        s2d, _ = self._stem_pair()
+        p = s2d.init_params(jax.random.PRNGKey(0))
+        x = jnp.asarray(rng.standard_normal((1, 33, 33, 3)), jnp.float32)
+        assert not s2d._use_space_to_depth(
+            x, p["W"], (2, 2), (1, 1), ((0, 0), (0, 0)))
+        deep, _ = self._stem_pair(C=64, k=3)
+        pd = deep.init_params(jax.random.PRNGKey(0))
+        xd = jnp.asarray(rng.standard_normal((1, 32, 32, 64)), jnp.float32)
+        assert not deep._use_space_to_depth(
+            xd, pd["W"], (2, 2), (1, 1), ((0, 0), (0, 0)))
+
+    def test_conv_algo_validated(self):
+        from deeplearning4j_tpu.nn.layers.convolution import ConvolutionLayer
+        bad = ConvolutionLayer(n_in=3, n_out=4, kernel_size=(3, 3),
+                               conv_algo="Direct")
+        p = bad.init_params(jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="conv_algo"):
+            bad.forward(p, {}, jnp.ones((1, 8, 8, 3)))
+
+    def test_bn_single_pass_stats_large_mean(self):
+        # E[x^2]-E[x]^2 catastrophically cancels at |mean| >> std; the
+        # running-mean pivot must recover exact variance once the running
+        # mean has warmed up (cold start deliberately matches cuDNN's
+        # unpivoted single-pass, see the BatchNormalization.forward
+        # comment), and the mean itself is exact even cold.
+        from deeplearning4j_tpu.nn.layers.convolution import (
+            BatchNormalization)
+        rng = np.random.default_rng(2)
+        for mean_scale in (0.0, 1e3, 1e4):
+            x = jnp.asarray(mean_scale + rng.standard_normal((64, 16)),
+                            jnp.float32)
+            bn = BatchNormalization(n_out=16)
+            p = bn.init_params(jax.random.PRNGKey(1))
+            st = bn.init_state()
+            _, st1 = bn.forward(p, st, x, train=True)
+            np.testing.assert_allclose(
+                (np.asarray(st1["mean"])) / (1 - bn.decay),
+                np.asarray(x, np.float64).mean(0), rtol=1e-4)
+            # warm pivot: state mean set to the data mean
+            warm = {"mean": jnp.asarray(np.asarray(x).mean(0)),
+                    "var": st["var"]}
+            _, nst = bn.forward(p, warm, x, train=True)
+            got_var = (np.asarray(nst["var"]) - bn.decay * 1.0) \
+                / (1 - bn.decay)
+            ref_var = np.asarray(x, np.float64).var(0)
+            np.testing.assert_allclose(got_var, ref_var, rtol=1e-4)
+
+    def test_bn_pivot_gradient_matches_two_pass(self):
+        from deeplearning4j_tpu.nn.layers.convolution import (
+            BatchNormalization)
+        rng = np.random.default_rng(3)
+        x = jnp.asarray(rng.standard_normal((8, 4, 4, 6)), jnp.float32)
+        bn = BatchNormalization(n_out=6)
+        p = bn.init_params(jax.random.PRNGKey(1))
+        ct = jnp.asarray(rng.standard_normal((8, 4, 4, 6)), jnp.float32)
+
+        def loss(v):
+            out, _ = bn.forward(p, bn.init_state(), v, train=True)
+            return (out * ct).sum()
+
+        def loss_two_pass(v):
+            m = jnp.mean(v, (0, 1, 2))
+            var = jnp.var(v, (0, 1, 2))
+            out = (v - m) / jnp.sqrt(var + bn.eps) * p["gamma"] + p["beta"]
+            return (out * ct).sum()
+
+        g1 = jax.grad(loss)(x)
+        g2 = jax.grad(loss_two_pass)(x)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   rtol=1e-3, atol=1e-4)
