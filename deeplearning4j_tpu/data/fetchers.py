@@ -180,3 +180,200 @@ class IrisDataSetIterator(ListDataSetIterator):
             ds = DataSet(ds.features[:num_examples],
                          ds.labels[:num_examples])
         super().__init__(ds, batch_size=batch_size)
+
+
+# ---------------------------------------------------------------------------
+# CIFAR-10 (binary batch format)
+# ---------------------------------------------------------------------------
+
+CIFAR_TRAIN_FILES = [f"data_batch_{i}.bin" for i in range(1, 6)]
+CIFAR_TEST_FILES = ["test_batch.bin"]
+CIFAR_RECORD_BYTES = 1 + 3 * 32 * 32  # label byte + CHW planar pixels
+CIFAR_LABELS = ["airplane", "automobile", "bird", "cat", "deer", "dog",
+                "frog", "horse", "ship", "truck"]
+
+
+def read_cifar_bin(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    """Parse one CIFAR-10 binary batch file (the format the reference's
+    CifarDataSetIterator consumes via CifarLoader): records of
+    [label u8][3072 u8 CHW planar]. Returns (uint8 NHWC images,
+    labels)."""
+    raw = np.fromfile(path, np.uint8)
+    if raw.size % CIFAR_RECORD_BYTES:
+        raise ValueError(f"{path}: size {raw.size} not a multiple of the "
+                         f"{CIFAR_RECORD_BYTES}-byte CIFAR record")
+    recs = raw.reshape(-1, CIFAR_RECORD_BYTES)
+    labels = recs[:, 0].copy()
+    chw = recs[:, 1:].reshape(-1, 3, 32, 32)
+    # whole-batch vectorized transpose: one numpy op beats 50k per-image
+    # ctypes calls (the native chw_to_hwc kernel is for per-image paths)
+    imgs = np.ascontiguousarray(chw.transpose(0, 2, 3, 1))
+    return imgs, labels
+
+
+def write_cifar_bin(path: str, images: np.ndarray,
+                    labels: np.ndarray) -> None:
+    """uint8 NHWC images + labels → CIFAR-10 binary batch format."""
+    images = np.ascontiguousarray(images, np.uint8)
+    n = images.shape[0]
+    recs = np.empty((n, CIFAR_RECORD_BYTES), np.uint8)
+    recs[:, 0] = labels
+    recs[:, 1:] = images.transpose(0, 3, 1, 2).reshape(n, -1)
+    recs.tofile(path)
+
+
+def synthesize_cifar_bin(directory: str, n_train: int = 1024,
+                         n_test: int = 256, seed: int = 43) -> None:
+    """Deterministic CIFAR-shaped dataset written as REAL binary batch
+    files (class = colored blob at a class-specific position + noise, so
+    conv models genuinely learn; same contract as synthesize_mnist_idx)."""
+    rng = np.random.default_rng(seed)
+    yy, xx = np.mgrid[0:32, 0:32]
+    protos = np.zeros((10, 32, 32, 3), np.float32)
+    for k in range(10):
+        r, c = 6 + (k % 5) * 5, 6 + (k // 5) * 16
+        blob = 180 * np.exp(-((yy - r) ** 2 + (xx - c) ** 2) / (2 * 16.0))
+        for ch in range(3):
+            protos[k, :, :, ch] = blob * (0.4 + 0.6 * ((k + ch) % 3 == 0))
+    os.makedirs(directory, exist_ok=True)
+    per_file = -(-n_train // len(CIFAR_TRAIN_FILES))
+    done = 0
+    for fn in CIFAR_TRAIN_FILES:
+        n = min(per_file, n_train - done)
+        if n <= 0:
+            n = 1
+        labels = rng.integers(0, 10, n).astype(np.uint8)
+        imgs = np.clip(protos[labels] + rng.normal(0, 25, (n, 32, 32, 3)),
+                       0, 255).astype(np.uint8)
+        write_cifar_bin(os.path.join(directory, fn), imgs, labels)
+        done += n
+    labels = rng.integers(0, 10, n_test).astype(np.uint8)
+    imgs = np.clip(protos[labels] + rng.normal(0, 25, (n_test, 32, 32, 3)),
+                   0, 255).astype(np.uint8)
+    write_cifar_bin(os.path.join(directory, CIFAR_TEST_FILES[0]), imgs,
+                    labels)
+
+
+class CifarDataSetIterator(ListDataSetIterator):
+    """Reference datasets/iterator/impl/CifarDataSetIterator.java (over
+    CifarLoader's binary batches), zero-egress: reads the real CIFAR-10
+    binary format from `path`; synthesize=True writes a deterministic
+    stand-in in the same format first (module docstring contract).
+    Features are NHWC floats, raw 0-255 like the reference default —
+    attach ImagePreProcessingScaler via set_pre_processor."""
+
+    def __init__(self, batch_size: int, num_examples: Optional[int] = None,
+                 train: bool = True, path: Optional[str] = None,
+                 synthesize: bool = False, shuffle: bool = False,
+                 seed: Optional[int] = None):
+        if path is None:
+            path = os.path.join(os.path.expanduser("~"),
+                                ".deeplearning4j_tpu", "cifar10")
+        files = CIFAR_TRAIN_FILES if train else CIFAR_TEST_FILES
+        first = os.path.join(path, files[0])
+        if not os.path.exists(first):
+            if not synthesize:
+                raise FileNotFoundError(
+                    f"CIFAR-10 binary batches not found under {path!r} "
+                    "(this environment cannot download); pass "
+                    "synthesize=True for a deterministic stand-in")
+            synthesize_cifar_bin(path)
+        img_parts, lab_parts = [], []
+        for fn in files:
+            p = os.path.join(path, fn)
+            if os.path.exists(p):
+                im, lb = read_cifar_bin(p)
+                img_parts.append(im)
+                lab_parts.append(lb)
+        imgs = np.concatenate(img_parts)[:num_examples]
+        labels = np.concatenate(lab_parts)[:num_examples]
+        ds = DataSet(imgs.astype(np.float32),
+                     np.eye(10, dtype=np.float32)[labels])
+        super().__init__(ds, batch_size=batch_size, shuffle=shuffle,
+                         seed=seed)
+
+
+# ---------------------------------------------------------------------------
+# LFW (labeled faces — directory-of-images layout)
+# ---------------------------------------------------------------------------
+
+
+def synthesize_lfw_dir(directory: str, num_people: int = 6,
+                       per_person: int = 8, size: int = 48,
+                       seed: int = 44) -> None:
+    """Deterministic LFW-shaped corpus: root/<person>/<img>.ppm with a
+    per-person base face pattern + noise (REAL image files on disk so
+    ImageRecordReader's decode+resize path stays load-bearing)."""
+    from .images import write_ppm
+    rng = np.random.default_rng(seed)
+    yy, xx = np.mgrid[0:size, 0:size]
+    for p in range(num_people):
+        pdir = os.path.join(directory, f"person_{p:02d}")
+        os.makedirs(pdir, exist_ok=True)
+        cy, cx = size // 2 + (p % 3 - 1) * size // 6, \
+            size // 2 + (p // 3 - 1) * size // 6
+        base = 160 * np.exp(-((yy - cy) ** 2 + (xx - cx) ** 2)
+                            / (2 * (size / 5.0) ** 2))
+        for i in range(per_person):
+            img = np.clip(
+                base[:, :, None] * (0.5 + 0.5 * np.eye(3)[p % 3])
+                + rng.normal(0, 20, (size, size, 3)), 0, 255
+            ).astype(np.uint8)
+            write_ppm(os.path.join(pdir, f"img_{i:03d}.ppm"), img)
+
+
+class LFWDataSetIterator(DataSetIterator):
+    """Reference datasets/iterator/impl/LFWDataSetIterator.java:
+    directory-of-faces → resized NHWC batches with person labels, via
+    ImageRecordReader (zero-egress: synthesize=True writes a
+    deterministic PPM corpus in the same layout)."""
+
+    def __init__(self, batch_size: int, image_shape=(64, 64, 3),
+                 path: Optional[str] = None, synthesize: bool = False,
+                 num_examples: Optional[int] = None):
+        from .images import ImageRecordReader, \
+            ImageRecordReaderDataSetIterator
+        if path is None:
+            path = os.path.join(os.path.expanduser("~"),
+                                ".deeplearning4j_tpu", "lfw")
+        if not (os.path.isdir(path) and any(
+                os.path.isdir(os.path.join(path, d))
+                for d in os.listdir(path) if not d.startswith("."))
+                if os.path.isdir(path) else False):
+            if not synthesize:
+                raise FileNotFoundError(
+                    f"no LFW-style directory tree under {path!r} (this "
+                    "environment cannot download); pass synthesize=True")
+            synthesize_lfw_dir(path)
+        h, w, c = image_shape
+        self._reader = ImageRecordReader(h, w, c, root=path)
+        self._inner = ImageRecordReaderDataSetIterator(
+            self._reader, batch_size=batch_size, scale=True)
+        self._limit = num_examples
+        self._served = 0
+
+    @property
+    def labels(self):
+        return self._reader.labels
+
+    def reset(self):
+        self._inner.reset()
+        self._served = 0
+
+    def batch_size(self):
+        return self._inner.batch_size()
+
+    def total_examples(self):
+        n = len(self._reader)
+        return n if self._limit is None else min(n, self._limit)
+
+    def __next__(self) -> DataSet:
+        if self._limit is not None and self._served >= self._limit:
+            raise StopIteration
+        ds = next(self._inner)
+        if self._limit is not None and \
+                self._served + ds.features.shape[0] > self._limit:
+            keep = self._limit - self._served
+            ds = DataSet(ds.features[:keep], ds.labels[:keep])
+        self._served += ds.features.shape[0]
+        return self._maybe_preprocess(ds)

@@ -47,7 +47,7 @@ def _load() -> Optional[ctypes.CDLL]:
     try:
         lib = ctypes.CDLL(_LIB_PATH)
         # AttributeError here means a stale/foreign .so — fall back.
-        if lib.etl_abi_version() != 1:
+        if lib.etl_abi_version() != 2:
             log.warning("native ETL ABI mismatch; using numpy paths")
             return None
         f32p = ctypes.POINTER(ctypes.c_float)
@@ -66,6 +66,12 @@ def _load() -> Optional[ctypes.CDLL]:
                                     ctypes.c_int64]
         lib.gather_rows_f32.argtypes = [f32p, i32p, f32p, ctypes.c_int64,
                                         ctypes.c_int64]
+        lib.u8_chw_to_hwc.argtypes = [u8p, u8p, ctypes.c_int64,
+                                      ctypes.c_int64, ctypes.c_int64]
+        lib.u8_resize_bilinear_hwc.argtypes = [
+            u8p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, u8p,
+            ctypes.c_int64, ctypes.c_int64]
+        lib.etl_set_omp_threads.argtypes = [ctypes.c_int]
         _lib = lib
     except (OSError, AttributeError) as e:
         log.info("native ETL load failed (%s); using numpy paths", e)
@@ -160,6 +166,65 @@ def gather_rows(table: np.ndarray, idx: np.ndarray) -> np.ndarray:
     lib.gather_rows_f32(
         _fptr(table), idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
         _fptr(out), idx.shape[0], table.shape[1])
+    return out
+
+
+def set_omp_threads(n: int) -> None:
+    """Cap the CALLING thread's OpenMP team for the native kernels. Pool
+    workers that parallelize at the image level pass 1 to avoid nesting
+    two parallelism layers (per-thread OpenMP ICV, so each worker sets
+    its own)."""
+    lib = _load()
+    if lib is not None:
+        lib.etl_set_omp_threads(int(n))
+
+
+def chw_to_hwc(img: np.ndarray) -> np.ndarray:
+    """Planar [C, H, W] uint8 → interleaved [H, W, C] (CIFAR binary
+    records → NHWC batches)."""
+    lib = _load()
+    img = np.ascontiguousarray(img, np.uint8)
+    if img.ndim != 3:
+        raise ValueError(f"chw_to_hwc needs [C,H,W], got {img.shape}")
+    c, h, w = img.shape
+    if lib is None:
+        return np.ascontiguousarray(img.transpose(1, 2, 0))
+    out = np.empty((h, w, c), np.uint8)
+    u8 = ctypes.POINTER(ctypes.c_uint8)
+    lib.u8_chw_to_hwc(img.ctypes.data_as(u8), out.ctypes.data_as(u8),
+                      c, h, w)
+    return out
+
+
+def resize_bilinear(img: np.ndarray, out_h: int, out_w: int) -> np.ndarray:
+    """HWC uint8 bilinear resize with half-pixel centers (the
+    ImageRecordReader scale step; matches OpenCV INTER_LINEAR, which
+    DataVec's NativeImageLoader uses)."""
+    lib = _load()
+    img = np.ascontiguousarray(img, np.uint8)
+    if img.ndim != 3:
+        raise ValueError(f"resize_bilinear needs [H,W,C], got {img.shape}")
+    h, w, c = img.shape
+    if (h, w) == (out_h, out_w):
+        return img
+    if lib is None:
+        # numpy fallback: same half-pixel-center sampling
+        fy = np.clip((np.arange(out_h) + 0.5) * (h / out_h) - 0.5, 0, None)
+        fx = np.clip((np.arange(out_w) + 0.5) * (w / out_w) - 0.5, 0, None)
+        y0 = np.minimum(fy.astype(np.int64), h - 1)
+        x0 = np.minimum(fx.astype(np.int64), w - 1)
+        y1 = np.minimum(y0 + 1, h - 1)
+        x1 = np.minimum(x0 + 1, w - 1)
+        wy = (fy - y0)[:, None, None]
+        wx = (fx - x0)[None, :, None]
+        f = img.astype(np.float32)
+        top = f[y0][:, x0] * (1 - wx) + f[y0][:, x1] * wx
+        bot = f[y1][:, x0] * (1 - wx) + f[y1][:, x1] * wx
+        return (top * (1 - wy) + bot * wy + 0.5).astype(np.uint8)
+    out = np.empty((out_h, out_w, c), np.uint8)
+    u8 = ctypes.POINTER(ctypes.c_uint8)
+    lib.u8_resize_bilinear_hwc(img.ctypes.data_as(u8), h, w, c,
+                               out.ctypes.data_as(u8), out_h, out_w)
     return out
 
 

@@ -15,15 +15,21 @@
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <vector>
+#ifdef _OPENMP
+#include <omp.h>
+#endif
 
 extern "C" {
 
 // uint8 pixels -> float32 in [min_range, max_range] (the
 // ImagePreProcessingScaler hot loop; dst may be the training batch
-// buffer directly).
+// buffer directly). OpenMP over chunks: this is a pure streaming loop,
+// so threads split the bandwidth.
 void u8_to_f32_scaled(const uint8_t* src, float* dst, int64_t n,
                       float max_pixel, float min_range, float max_range) {
     const float span = (max_range - min_range) / max_pixel;
+#pragma omp parallel for schedule(static) if (n > 1 << 16)
     for (int64_t i = 0; i < n; ++i) {
         dst[i] = static_cast<float>(src[i]) * span + min_range;
     }
@@ -33,6 +39,7 @@ void u8_to_f32_scaled(const uint8_t* src, float* dst, int64_t n,
 // feature axis of size c_len (NormalizerStandardize.transform hot loop).
 void f32_standardize(float* data, int64_t rows, int64_t c_len,
                      const float* mean, const float* stddev) {
+#pragma omp parallel for schedule(static) if (rows * c_len > 1 << 16)
     for (int64_t r = 0; r < rows; ++r) {
         float* row = data + r * c_len;
         for (int64_t c = 0; c < c_len; ++c) {
@@ -90,6 +97,82 @@ void one_hot_f32(const int32_t* labels, float* out, int64_t n,
     }
 }
 
-int etl_abi_version() { return 1; }
+// CHW planar pixels -> HWC interleaved (CIFAR binary records and other
+// channels-first sources feeding the NHWC train step).
+void u8_chw_to_hwc(const uint8_t* src, uint8_t* dst, int64_t c, int64_t h,
+                   int64_t w) {
+    const int64_t plane = h * w;
+    for (int64_t y = 0; y < h; ++y) {
+        for (int64_t x = 0; x < w; ++x) {
+            const int64_t px = y * w + x;
+            uint8_t* d = dst + px * c;
+            for (int64_t ch = 0; ch < c; ++ch) {
+                d[ch] = src[ch * plane + px];
+            }
+        }
+    }
+}
+
+// Bilinear resize of an HWC uint8 image (ImageRecordReader's
+// scale-to-network-input step; half-pixel-center sampling like OpenCV's
+// INTER_LINEAR, which is what DataVec's NativeImageLoader uses).
+void u8_resize_bilinear_hwc(const uint8_t* src, int64_t h, int64_t w,
+                            int64_t c, uint8_t* dst, int64_t oh,
+                            int64_t ow) {
+    const float sy = static_cast<float>(h) / static_cast<float>(oh);
+    const float sx = static_cast<float>(w) / static_cast<float>(ow);
+    // precompute the column sample positions/weights once per image
+    std::vector<int64_t> x0s(ow), x1s(ow);
+    std::vector<float> wxs(ow);
+    for (int64_t x = 0; x < ow; ++x) {
+        float fx = (static_cast<float>(x) + 0.5f) * sx - 0.5f;
+        if (fx < 0) fx = 0;
+        int64_t x0 = static_cast<int64_t>(fx);
+        if (x0 > w - 1) x0 = w - 1;
+        x0s[x] = x0;
+        x1s[x] = x0 + 1 < w ? x0 + 1 : w - 1;
+        wxs[x] = fx - static_cast<float>(x0);
+    }
+#pragma omp parallel for schedule(static) if (oh * ow * c > 1 << 15)
+    for (int64_t y = 0; y < oh; ++y) {
+        float fy = (static_cast<float>(y) + 0.5f) * sy - 0.5f;
+        if (fy < 0) fy = 0;
+        int64_t y0 = static_cast<int64_t>(fy);
+        if (y0 > h - 1) y0 = h - 1;
+        int64_t y1 = y0 + 1 < h ? y0 + 1 : h - 1;
+        const float wy = fy - static_cast<float>(y0);
+        const uint8_t* row0 = src + y0 * w * c;
+        const uint8_t* row1 = src + y1 * w * c;
+        uint8_t* drow = dst + y * ow * c;
+        for (int64_t x = 0; x < ow; ++x) {
+            const float wx = wxs[x];
+            const uint8_t* p00 = row0 + x0s[x] * c;
+            const uint8_t* p01 = row0 + x1s[x] * c;
+            const uint8_t* p10 = row1 + x0s[x] * c;
+            const uint8_t* p11 = row1 + x1s[x] * c;
+            uint8_t* d = drow + x * c;
+            for (int64_t ch = 0; ch < c; ++ch) {
+                const float top = p00[ch] + (p01[ch] - p00[ch]) * wx;
+                const float bot = p10[ch] + (p11[ch] - p10[ch]) * wx;
+                const float v = top + (bot - top) * wy;
+                d[ch] = static_cast<uint8_t>(v + 0.5f);
+            }
+        }
+    }
+}
+
+// Cap this thread's OpenMP team size. Worker threads that already
+// parallelize at the image level (ImageRecordReaderDataSetIterator's
+// pool) call this with 1 so the per-row pragmas don't nest a second
+// parallelism layer and oversubscribe the host.
+void etl_set_omp_threads(int n) {
+#ifdef _OPENMP
+    omp_set_num_threads(n > 0 ? n : 1);
+#else
+    (void)n;
+#endif
+}
+
+int etl_abi_version() { return 2; }
 
 }  // extern "C"

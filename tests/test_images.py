@@ -1,0 +1,183 @@
+"""Image pipeline: ImageRecordReader, CIFAR/LFW fetchers, export-based
+training (VERDICT r2 items 4/6: image record reader feeding NHWC through
+native ETL; CifarDataSetIterator/LFWDataSetIterator roles; 
+BatchAndExportDataSetsFunction/ExportSupport role)."""
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import native_etl
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.export import (ExportedDataSetIterator,
+                                            export_datasets)
+from deeplearning4j_tpu.data.fetchers import (
+    CifarDataSetIterator, LFWDataSetIterator, read_cifar_bin,
+    synthesize_cifar_bin, synthesize_lfw_dir, write_cifar_bin)
+from deeplearning4j_tpu.data.images import (ImageRecordReader,
+                                            ImageRecordReaderDataSetIterator,
+                                            decode_image, read_pnm,
+                                            write_ppm)
+from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+
+
+class TestPnm:
+    def test_roundtrip_rgb_and_gray(self, tmp_path):
+        rng = np.random.default_rng(0)
+        for c in (1, 3):
+            img = rng.integers(0, 255, (9, 7, c), dtype=np.uint8)
+            p = str(tmp_path / f"img{c}.ppm")
+            write_ppm(p, img)
+            np.testing.assert_array_equal(read_pnm(p), img)
+
+    def test_decode_channel_conversion(self, tmp_path):
+        rng = np.random.default_rng(1)
+        img = rng.integers(0, 255, (6, 6, 3), dtype=np.uint8)
+        p = str(tmp_path / "x.ppm")
+        write_ppm(p, img)
+        gray = decode_image(p, channels=1)
+        assert gray.shape == (6, 6, 1)
+        # luma weights
+        expect = (0.299 * img[..., 0] + 0.587 * img[..., 1]
+                  + 0.114 * img[..., 2] + 0.5).astype(np.uint8)
+        np.testing.assert_array_equal(gray[..., 0], expect)
+
+
+class TestNativeImageKernels:
+    def test_chw_to_hwc_matches_transpose(self):
+        rng = np.random.default_rng(2)
+        img = rng.integers(0, 255, (3, 5, 8), dtype=np.uint8)
+        np.testing.assert_array_equal(native_etl.chw_to_hwc(img),
+                                      img.transpose(1, 2, 0))
+
+    def test_resize_native_vs_numpy_paths(self):
+        rng = np.random.default_rng(3)
+        img = rng.integers(0, 255, (32, 40, 3), dtype=np.uint8)
+        out = native_etl.resize_bilinear(img, 17, 23)
+        lib, native_etl._lib = native_etl._lib, None
+        tried = native_etl._tried
+        native_etl._tried = True
+        try:
+            ref = native_etl.resize_bilinear(img, 17, 23)
+        finally:
+            native_etl._lib, native_etl._tried = lib, tried
+        assert out.shape == ref.shape == (17, 23, 3)
+        assert np.max(np.abs(out.astype(int) - ref.astype(int))) <= 1
+
+    def test_resize_identity(self):
+        img = np.arange(4 * 4 * 3, dtype=np.uint8).reshape(4, 4, 3)
+        np.testing.assert_array_equal(
+            native_etl.resize_bilinear(img, 4, 4), img)
+
+
+class TestImageRecordReader:
+    def test_directory_labels_and_shapes(self, tmp_path):
+        synthesize_lfw_dir(str(tmp_path), num_people=3, per_person=4,
+                           size=20)
+        rr = ImageRecordReader(16, 16, 3, root=str(tmp_path))
+        assert rr.labels == ["person_00", "person_01", "person_02"]
+        assert len(rr) == 12
+        img, label = next(iter(rr))
+        assert img.shape == (16, 16, 3) and img.dtype == np.uint8
+        assert 0 <= label < 3
+
+    def test_iterator_batches_scaled(self, tmp_path):
+        synthesize_lfw_dir(str(tmp_path), num_people=2, per_person=5,
+                           size=12)
+        rr = ImageRecordReader(8, 8, 3, root=str(tmp_path))
+        it = ImageRecordReaderDataSetIterator(rr, batch_size=4, workers=2)
+        sizes = []
+        for ds in it:
+            assert ds.features.shape[1:] == (8, 8, 3)
+            assert ds.features.dtype == np.float32
+            assert float(ds.features.max()) <= 1.0
+            assert ds.labels.shape[1] == 2
+            sizes.append(ds.features.shape[0])
+        assert sum(sizes) == 10
+        it.reset()
+        assert sum(ds.features.shape[0] for ds in it) == 10
+
+
+class TestCifar:
+    def test_binary_roundtrip(self, tmp_path):
+        rng = np.random.default_rng(4)
+        imgs = rng.integers(0, 255, (6, 32, 32, 3), dtype=np.uint8)
+        labels = rng.integers(0, 10, 6).astype(np.uint8)
+        p = str(tmp_path / "batch.bin")
+        write_cifar_bin(p, imgs, labels)
+        rimgs, rlabels = read_cifar_bin(p)
+        np.testing.assert_array_equal(rimgs, imgs)
+        np.testing.assert_array_equal(rlabels, labels)
+
+    def test_iterator_synthesizes_and_reads(self, tmp_path):
+        it = CifarDataSetIterator(16, train=True, path=str(tmp_path),
+                                  synthesize=True)
+        ds = next(iter(it))
+        assert ds.features.shape == (16, 32, 32, 3)
+        assert ds.labels.shape == (16, 10)
+        # test split shares the files
+        it2 = CifarDataSetIterator(16, train=False, path=str(tmp_path))
+        assert next(iter(it2)).features.shape == (16, 32, 32, 3)
+
+    def test_missing_without_synthesize_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            CifarDataSetIterator(8, path=str(tmp_path / "nope"))
+
+
+class TestLfwEndToEnd:
+    def test_lenet_trains_from_disk_images(self, tmp_path):
+        """VERDICT item 4 'Done' criterion: a conv net trains end-to-end
+        from on-disk images with a normalizer and learns."""
+        from deeplearning4j_tpu import (Adam, InputType, MultiLayerNetwork,
+                                        NeuralNetConfiguration, OutputLayer,
+                                        DenseLayer, WeightInit)
+        from deeplearning4j_tpu.nn.layers.convolution import (
+            ConvolutionLayer, ConvolutionMode, PoolingType,
+            SubsamplingLayer)
+
+        synthesize_lfw_dir(str(tmp_path), num_people=3, per_person=12,
+                           size=20)
+        it = LFWDataSetIterator(12, image_shape=(16, 16, 3),
+                                path=str(tmp_path))
+        conf = (NeuralNetConfiguration.builder().seed(7)
+                .weight_init(WeightInit.XAVIER).updater(Adam(3e-3))
+                .activation("identity")
+                .list()
+                .layer(ConvolutionLayer(kernel_size=(3, 3), n_out=8,
+                                        convolution_mode=ConvolutionMode
+                                        .SAME, activation="relu"))
+                .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2),
+                                        pooling_type=PoolingType.MAX))
+                .layer(DenseLayer(n_out=32, activation="relu"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.convolutional(16, 16, 3))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit(it, epochs=30)
+        # evaluate on the training corpus (tiny synthetic set)
+        it.reset()
+        correct = total = 0
+        for ds in it:
+            pred = net.predict(ds.features)
+            correct += int((pred == ds.labels.argmax(1)).sum())
+            total += len(pred)
+        assert correct / total > 0.8, f"accuracy {correct}/{total}"
+
+
+class TestExport:
+    def test_export_rebatches_and_streams(self, tmp_path):
+        rng = np.random.default_rng(5)
+        x = rng.standard_normal((50, 6)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 50)]
+        src = ListDataSetIterator(DataSet(x, y), batch_size=7)
+        paths = export_datasets(src, str(tmp_path), batch_size=16)
+        assert [os.path.basename(p) for p in paths] == \
+            [f"dataset_{i}.npz" for i in range(4)]  # 16+16+16+2
+        out = ExportedDataSetIterator(str(tmp_path))
+        assert out.batch_size() == 16
+        feats = np.concatenate([ds.features for ds in out])
+        np.testing.assert_allclose(feats, x)
+        out.reset()
+        labs = np.concatenate([ds.labels for ds in out])
+        np.testing.assert_allclose(labs, y)
