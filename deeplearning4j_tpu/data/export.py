@@ -42,10 +42,10 @@ def export_datasets(iterator, directory: str, batch_size: int,
         nonlocal count
         if not buf_f:
             return
-        f = np.concatenate(buf_f)[:n]
-        l = np.concatenate(buf_l)[:n]
-        rest_f = np.concatenate(buf_f)[n:]
-        rest_l = np.concatenate(buf_l)[n:]
+        cat_f = np.concatenate(buf_f)
+        cat_l = np.concatenate(buf_l)
+        f, rest_f = cat_f[:n], cat_f[n:]
+        l, rest_l = cat_l[:n], cat_l[n:]
         buf_f.clear()
         buf_l.clear()
         if rest_f.shape[0]:

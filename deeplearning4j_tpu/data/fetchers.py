@@ -336,10 +336,10 @@ class LFWDataSetIterator(DataSetIterator):
         if path is None:
             path = os.path.join(os.path.expanduser("~"),
                                 ".deeplearning4j_tpu", "lfw")
-        if not (os.path.isdir(path) and any(
-                os.path.isdir(os.path.join(path, d))
-                for d in os.listdir(path) if not d.startswith("."))
-                if os.path.isdir(path) else False):
+        has_people = os.path.isdir(path) and any(
+            os.path.isdir(os.path.join(path, d))
+            for d in os.listdir(path) if not d.startswith("."))
+        if not has_people:
             if not synthesize:
                 raise FileNotFoundError(
                     f"no LFW-style directory tree under {path!r} (this "

@@ -146,14 +146,26 @@ class ImageRecordReader(RecordReader):
     def reset(self):
         self._i = 0
 
+    @property
+    def items(self) -> List[Tuple[str, int]]:
+        """The (path, label index) records, in iteration order."""
+        return self._items
+
+    def load(self, item: Tuple[str, int]) -> Tuple[np.ndarray, int]:
+        """Decode + resize one record — THE single implementation of the
+        per-record pipeline (the sequential __next__ and the batched
+        iterator's worker pool both call it)."""
+        path, label = item
+        img = decode_image(path, self.channels)
+        return native_etl.resize_bilinear(img, self.height,
+                                          self.width), label
+
     def __next__(self) -> Tuple[np.ndarray, int]:
         if self._i >= len(self._items):
             raise StopIteration
-        path, label = self._items[self._i]
+        item = self._items[self._i]
         self._i += 1
-        img = decode_image(path, self.channels)
-        img = native_etl.resize_bilinear(img, self.height, self.width)
-        return img, label
+        return self.load(item)
 
 
 class ImageRecordReaderDataSetIterator(DataSetIterator):
@@ -188,14 +200,8 @@ class ImageRecordReaderDataSetIterator(DataSetIterator):
     def total_examples(self):
         return len(self.reader)
 
-    def _decode_one(self, item):
-        path, label = item
-        img = decode_image(path, self.reader.channels)
-        return native_etl.resize_bilinear(
-            img, self.reader.height, self.reader.width), label
-
     def __next__(self) -> DataSet:
-        items = self.reader._items[self._i:self._i + self._batch]
+        items = self.reader.items[self._i:self._i + self._batch]
         if not items:
             raise StopIteration
         self._i += len(items)
@@ -209,9 +215,9 @@ class ImageRecordReaderDataSetIterator(DataSetIterator):
                     self.workers,
                     initializer=native_etl.set_omp_threads,
                     initargs=(1,))
-            decoded = list(self._pool.map(self._decode_one, items))
+            decoded = list(self._pool.map(self.reader.load, items))
         else:
-            decoded = [self._decode_one(it) for it in items]
+            decoded = [self.reader.load(it) for it in items]
         batch = np.stack([d[0] for d in decoded])  # uint8 [B, H, W, C]
         labels = [d[1] for d in decoded]
         feats = native_etl.u8_to_f32_scaled(batch, self.max_pixel) \

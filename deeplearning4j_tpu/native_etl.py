@@ -24,13 +24,13 @@ _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
-def _build() -> bool:
+def _build(force: bool = False) -> bool:
     src = os.path.join(_NATIVE_DIR, "etl.cpp")
     if not os.path.exists(src):
         return False
     try:
-        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
-                       capture_output=True, timeout=120)
+        cmd = ["make", "-C", _NATIVE_DIR] + (["-B"] if force else [])
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         return os.path.exists(_LIB_PATH)
     except (subprocess.SubprocessError, OSError) as e:
         log.info("native ETL build unavailable (%s); using numpy paths", e)
@@ -48,8 +48,18 @@ def _load() -> Optional[ctypes.CDLL]:
         lib = ctypes.CDLL(_LIB_PATH)
         # AttributeError here means a stale/foreign .so — fall back.
         if lib.etl_abi_version() != 2:
-            log.warning("native ETL ABI mismatch; using numpy paths")
-            return None
+            # stale checkout artifact: rebuild in place and reload once
+            # (silently dropping to numpy would be a large quiet ETL
+            # regression on every install that predates the ABI bump)
+            log.info("native ETL ABI mismatch; rebuilding")
+            if not _build(force=True):
+                log.warning("native ETL rebuild failed; using numpy paths")
+                return None
+            lib = ctypes.CDLL(_LIB_PATH)
+            if lib.etl_abi_version() != 2:
+                log.warning("native ETL still ABI-mismatched after "
+                            "rebuild; using numpy paths")
+                return None
         f32p = ctypes.POINTER(ctypes.c_float)
         u8p = ctypes.POINTER(ctypes.c_uint8)
         i32p = ctypes.POINTER(ctypes.c_int32)
@@ -66,8 +76,6 @@ def _load() -> Optional[ctypes.CDLL]:
                                     ctypes.c_int64]
         lib.gather_rows_f32.argtypes = [f32p, i32p, f32p, ctypes.c_int64,
                                         ctypes.c_int64]
-        lib.u8_chw_to_hwc.argtypes = [u8p, u8p, ctypes.c_int64,
-                                      ctypes.c_int64, ctypes.c_int64]
         lib.u8_resize_bilinear_hwc.argtypes = [
             u8p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, u8p,
             ctypes.c_int64, ctypes.c_int64]
@@ -177,23 +185,6 @@ def set_omp_threads(n: int) -> None:
     lib = _load()
     if lib is not None:
         lib.etl_set_omp_threads(int(n))
-
-
-def chw_to_hwc(img: np.ndarray) -> np.ndarray:
-    """Planar [C, H, W] uint8 → interleaved [H, W, C] (CIFAR binary
-    records → NHWC batches)."""
-    lib = _load()
-    img = np.ascontiguousarray(img, np.uint8)
-    if img.ndim != 3:
-        raise ValueError(f"chw_to_hwc needs [C,H,W], got {img.shape}")
-    c, h, w = img.shape
-    if lib is None:
-        return np.ascontiguousarray(img.transpose(1, 2, 0))
-    out = np.empty((h, w, c), np.uint8)
-    u8 = ctypes.POINTER(ctypes.c_uint8)
-    lib.u8_chw_to_hwc(img.ctypes.data_as(u8), out.ctypes.data_as(u8),
-                      c, h, w)
-    return out
 
 
 def resize_bilinear(img: np.ndarray, out_h: int, out_w: int) -> np.ndarray:

@@ -1,0 +1,268 @@
+"""Sharded Word2Vec with device-side pair generation.
+
+Two reference roles in one TPU-native engine:
+
+* **AggregateSkipGram** (`learning/impl/elements/SkipGram.java:176-283`):
+  the reference batches skip-gram rounds into native ops precisely
+  because JVM-side pair loops can't feed the math. Round-2 profiling hit
+  the same wall here — host pair generation capped words/sec at 57-137k
+  with the device mostly idle. This engine uploads the indexed corpus
+  ONCE and generates pairs inside the jitted step: dynamic windows,
+  sentence-boundary masking, frequent-word subsampling and negative
+  sampling all run on device, and an epoch is a lax.scan over corpus
+  chunks — zero host work per step.
+
+* **dl4j-spark-nlp Word2Vec** (`spark/models/embeddings/word2vec/
+  Word2Vec.java`, `FirstIterationFunction.java`): per-partition
+  skip-gram over a broadcast vocab, merged by accumulator. Here the
+  partition axis is a `jax.sharding.Mesh` data axis: chunk positions
+  shard across devices, tables stay replicated, and XLA inserts the
+  all-reduce that the reference's accumulator merge hand-rolls. The
+  update schedule is batch-synchronous (one merged update per chunk)
+  rather than the Spark job's merge-at-end-of-partition — a documented
+  strengthening (more frequent sync can only reduce staleness).
+
+Divergences from the host-side `BatchedEmbeddingTrainer` (all documented):
+  * Subsampling drops a token as center AND context but does not close
+    the window over it (device shapes are static; word2vec.c compacts
+    the sentence). With sampling=0 (the default) there is no difference.
+  * Negatives are drawn per CENTER from the counts^0.75 table and shared
+    across that center's contexts, with the negative loss term weighted
+    by the context count — the same expected gradient as per-pair draws
+    with 10x fewer gather/scatter rows (profiled: per-pair negative
+    gathers+scatter-adds were 70% of the step).
+  * The per-row update averaging means one chunk = ONE effective step
+    for every row it touches. On realistic vocabularies rows appear
+    ~once per chunk and the schedule matches the host trainer's; for
+    toy vocabularies where every row is hit many times per chunk, use a
+    smaller `chunk` to keep step granularity (tests do).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .vocab import VocabCache, unigram_table
+
+Array = jax.Array
+
+
+def _count_scale(grad, idx, weights):
+    """Per-row 1/touch-count scaling (same schedule as
+    embeddings._row_scale: a row touched k times in the batch takes the
+    average of its k per-pair steps)."""
+    counts = jnp.zeros((grad.shape[0],), grad.dtype).at[
+        idx.reshape(-1)].add(weights.reshape(-1).astype(grad.dtype))
+    return grad / jnp.clip(counts, 1.0)[:, None]
+
+
+def _make_superstep(window: int, negative: int, chunk: int,
+                    steps_per_call: int,
+                    mesh: Optional[jax.sharding.Mesh] = None):
+    """Build the jitted multi-chunk training function. All shape-bearing
+    hyperparameters are baked in statically. Under a mesh, the chunk
+    (position) axis is sharded across `axis` — tables stay replicated
+    and GSPMD inserts the gradient all-reduce (the accumulator-merge of
+    the reference's FirstIterationFunction)."""
+    offs = np.concatenate([np.arange(-window, 0),
+                           np.arange(1, window + 1)]).astype(np.int32)
+
+    def shard_chunk(x):
+        if mesh is None:
+            return x
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        axis = mesh.axis_names[0]
+        spec = P(axis, *([None] * (x.ndim - 1)))
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, spec))
+
+    def one_chunk(tables, corpus, sent, keep_thresh, unigram, start, key,
+                  lr):
+        n = corpus.shape[0]
+        k_win, k_neg, k_keep = jax.random.split(key, 3)
+        idx = shard_chunk(start + jnp.arange(chunk, dtype=jnp.int32))
+        idx_c = jnp.minimum(idx, n - 1)
+        centers = corpus[idx_c]                          # [C]
+        P = idx[:, None] + offs[None, :]                 # [C, 2W]
+        Pc = jnp.clip(P, 0, n - 1)
+        contexts = corpus[Pc]                            # [C, 2W]
+        b = jax.random.randint(k_win, (chunk,), 1, window + 1)
+        same_sent = sent[Pc] == sent[idx_c][:, None]
+        valid = ((jnp.abs(offs)[None, :] <= b[:, None])
+                 & (P >= 0) & (P < n) & same_sent
+                 & (idx < n)[:, None])
+        # frequent-word subsampling, device-side: drop as center/context
+        u = jax.random.uniform(k_keep, (chunk, 2 * window + 1))
+        keep_ctr = u[:, 0] < keep_thresh[centers]
+        keep_ctx = u[:, 1:] < keep_thresh[contexts]
+        valid = valid & keep_ctr[:, None] & keep_ctx
+        # Negatives are drawn per CENTER and shared across its contexts,
+        # with the negative term weighted by the center's valid-context
+        # count m — same expected gradient as word2vec.c's m*K per-pair
+        # draws, 10x fewer gather/scatter rows (profiled: per-pair
+        # negative gathers+scatter-adds were 70% of the step).
+        negs = unigram[jax.random.randint(
+            k_neg, (chunk, negative), 0, unigram.shape[0])]
+        m = valid.astype(jnp.float32).sum(1)                 # [C]
+
+        def loss_fn(t):
+            syn0, syn1neg = t["syn0"], t["syn1neg"]
+            h = jnp.take(syn0, centers, axis=0)              # [C, D]
+            pos = jnp.take(syn1neg, contexts, axis=0)        # [C, 2W, D]
+            neg = jnp.take(syn1neg, negs, axis=0)            # [C, K, D]
+            vm = valid.astype(syn0.dtype)
+            pos_score = jnp.einsum("cd,cwd->cw", h, pos)
+            neg_score = jnp.einsum("cd,ckd->ck", h, neg)
+            # SUM over pairs: per-pair full lr steps applied batchwise
+            # (embeddings.py update-schedule contract)
+            return -((jax.nn.log_sigmoid(pos_score) * vm).sum()
+                     + (jax.nn.log_sigmoid(-neg_score)
+                        * m[:, None]).sum())
+
+        loss, grads = jax.value_and_grad(loss_fn)(tables)
+        vm = valid.astype(jnp.float32)
+        grads["syn0"] = _count_scale(grads["syn0"], centers, m)
+        syn1_idx = jnp.concatenate(
+            [contexts.reshape(-1), negs.reshape(-1)])
+        syn1_w = jnp.concatenate(
+            [vm.reshape(-1), jnp.repeat(m, negative)])
+        grads["syn1neg"] = _count_scale(grads["syn1neg"], syn1_idx, syn1_w)
+        new = {k: tables[k] - lr * grads[k] for k in tables}
+        return new, loss / jnp.clip(vm.sum(), 1.0)
+
+    def superstep(tables, corpus, sent, keep_thresh, unigram, starts, key,
+                  lrs):
+        def body(carry, xs):
+            t, k = carry
+            start, lr = xs
+            k, sub = jax.random.split(k)
+            t, loss = one_chunk(t, corpus, sent, keep_thresh, unigram,
+                                start, sub, lr)
+            return (t, k), loss
+        (tables, key), losses = jax.lax.scan(
+            body, (tables, key), (starts, lrs))
+        return tables, key, losses
+
+    return jax.jit(superstep, donate_argnums=(0,))
+
+
+class ShardedWord2Vec:
+    """Device-corpus skip-gram/NS trainer, optionally sharded over a
+    data-parallel mesh (see module docstring)."""
+
+    def __init__(self, cache: VocabCache, layer_size: int = 100,
+                 window: int = 5, negative: int = 5,
+                 learning_rate: float = 0.025,
+                 min_learning_rate: float = 1e-4, chunk: int = 2048,
+                 steps_per_call: int = 8, sampling: float = 0.0,
+                 seed: int = 42, mesh: Optional[jax.sharding.Mesh] = None,
+                 dtype=jnp.float32):
+        if negative <= 0:
+            raise NotImplementedError(
+                "ShardedWord2Vec trains negative sampling; use "
+                "BatchedEmbeddingTrainer for hierarchical softmax")
+        self.cache = cache
+        self.layer_size = int(layer_size)
+        self.window = int(window)
+        self.negative = int(negative)
+        self.lr = float(learning_rate)
+        self.min_lr = float(min_learning_rate)
+        self.chunk = int(chunk)
+        self.steps_per_call = int(steps_per_call)
+        self.sampling = float(sampling)
+        self.seed = int(seed)
+        self.mesh = mesh
+        V, D = len(cache), self.layer_size
+        key = jax.random.PRNGKey(seed)
+        self.tables = {
+            "syn0": jax.random.uniform(key, (V, D), dtype,
+                                       -0.5 / D, 0.5 / D),
+            "syn1neg": jnp.zeros((V, D), dtype),
+        }
+        self._unigram = jnp.asarray(unigram_table(cache))
+        # keep-probability per word (word2vec subsampling formula);
+        # sampling=0 keeps everything
+        if self.sampling > 0:
+            total = max(1, cache.total_word_count)
+            freqs = np.array(
+                [cache.words[w].count / total for w in cache.index2word],
+                np.float32)
+            keep = np.minimum(1.0, np.sqrt(self.sampling / freqs)
+                              + self.sampling / freqs)
+        else:
+            keep = np.ones(V, np.float32)
+        self._keep = jnp.asarray(keep)
+        if mesh is not None and self.chunk % mesh.size:
+            raise ValueError(f"chunk={self.chunk} must divide evenly over "
+                             f"the {mesh.size}-device mesh")
+        self._step_fn = _make_superstep(self.window, self.negative,
+                                        self.chunk, self.steps_per_call,
+                                        mesh=mesh)
+        self._key = jax.random.PRNGKey(seed + 1)
+        self.last_losses = None
+
+    def _device_corpus(self, token_ids, sent_ids):
+        token_ids = np.ascontiguousarray(token_ids, np.int32)
+        sent_ids = np.ascontiguousarray(sent_ids, np.int32)
+        if token_ids.shape != sent_ids.shape or token_ids.ndim != 1:
+            raise ValueError("token_ids/sent_ids must be equal 1-D arrays")
+        # the corpus is device-RESIDENT by contract: upload once and keep
+        # (repeat fit_corpus calls — epochs, benchmarks — must not re-ship
+        # it through the host link)
+        key = (token_ids.ctypes.data, token_ids.shape, sent_ids.ctypes.data)
+        if getattr(self, "_corpus_key", None) != key:
+            self._corpus_dev = (jnp.asarray(token_ids),
+                                jnp.asarray(sent_ids))
+            self._corpus_key = key
+        return self._corpus_dev
+
+    def fit_corpus(self, token_ids: np.ndarray, sent_ids: np.ndarray,
+                   epochs: int = 1) -> "ShardedWord2Vec":
+        """Train over a flat indexed corpus. `sent_ids[i]` tags the
+        sentence of token i (windows never cross a boundary)."""
+        import contextlib
+        corpus, sent = self._device_corpus(token_ids, sent_ids)
+        n = int(corpus.shape[0])
+        spc = self.chunk * self.steps_per_call
+        calls = max(1, -(-n // spc))
+        total_steps = max(1, epochs * calls * self.steps_per_call)
+        step = 0
+        ctx = self.mesh if self.mesh is not None else \
+            contextlib.nullcontext()
+        with ctx:
+            for _ in range(epochs):
+                for c in range(calls):
+                    starts = np.arange(self.steps_per_call,
+                                       dtype=np.int32) * self.chunk \
+                        + c * spc
+                    lrs = np.maximum(
+                        self.min_lr,
+                        self.lr * (1.0 - (step + np.arange(
+                            self.steps_per_call)) / total_steps)
+                    ).astype(np.float32)
+                    self.tables, self._key, losses = self._step_fn(
+                        self.tables, corpus, sent, self._keep,
+                        self._unigram, jnp.asarray(starts),
+                        self._key, jnp.asarray(lrs))
+                    step += self.steps_per_call
+            self.last_losses = losses
+        return self
+
+    def vectors(self) -> np.ndarray:
+        return np.asarray(self.tables["syn0"])
+
+
+def corpus_arrays(indexed_sentences):
+    """[sentence arrays] → (flat token ids, sentence ids) for
+    fit_corpus."""
+    if not indexed_sentences:
+        return (np.empty(0, np.int32), np.empty(0, np.int32))
+    toks = np.concatenate([np.asarray(s, np.int32)
+                           for s in indexed_sentences])
+    sids = np.concatenate([np.full(len(s), i, np.int32)
+                           for i, s in enumerate(indexed_sentences)])
+    return toks, sids

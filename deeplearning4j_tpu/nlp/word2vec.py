@@ -126,6 +126,49 @@ class Word2Vec(WordVectors):
             min_word_frequency=kw.get("min_word_frequency", 1)).build(
                 tokenized)
         self.vocab = cache
+        if kw.get("mesh") is not None or kw.get("device_corpus"):
+            # Sharded device-corpus engine (the dl4j-spark-nlp Word2Vec
+            # role; see nlp/distributed.py). Skip-gram + negative
+            # sampling only — loud error otherwise, same contract as
+            # other documented-unsupported combinations.
+            from .distributed import ShardedWord2Vec, corpus_arrays
+            # loud-contract validation: HS must be EXPLICITLY disabled
+            # (silently dropping the reference's HS+NS combination would
+            # change training semantics without telling anyone), and
+            # negative must be explicitly positive (builder default is 0)
+            if kw.get("use_hierarchic_softmax", True):
+                raise ValueError(
+                    "the sharded device-corpus engine trains negative "
+                    "sampling only; call use_hierarchic_softmax(False) "
+                    "explicitly (or drop mesh()/device_corpus())")
+            if kw.get("negative", 0) <= 0:
+                raise ValueError(
+                    "the sharded device-corpus engine needs "
+                    "negative_sample(n > 0)")
+            if kw.get("elements_learning_algorithm",
+                      "skipgram") == "cbow":
+                raise ValueError("the sharded device-corpus engine does "
+                                 "not implement CBOW")
+            sharded = ShardedWord2Vec(
+                cache,
+                layer_size=kw.get("layer_size", 100),
+                window=kw.get("window_size", 5),
+                negative=kw["negative"],
+                learning_rate=kw.get("learning_rate", 0.025),
+                min_learning_rate=kw.get("min_learning_rate", 1e-4),
+                sampling=kw.get("sampling", 0.0),
+                chunk=kw.get("chunk", 2048),
+                seed=kw.get("seed", 42),
+                mesh=kw.get("mesh"))
+            toks, sids = corpus_arrays(
+                sentences_to_indices(tokenized, cache))
+            sharded.fit_corpus(toks, sids,
+                               epochs=kw.get("epochs", 1)
+                               * kw.get("iterations", 1))
+            self._trainer = sharded
+            self._vectors = sharded.vectors()
+            self._normed = None
+            return self
         # Reference defaults: useHierarchicSoftmax=true, negative=0
         # (Word2Vec.java builder defaults).
         trainer = BatchedEmbeddingTrainer(
@@ -205,6 +248,22 @@ class Word2VecBuilder:
 
     def seed(self, s):
         return self._set("seed", int(s))
+
+    def chunk(self, n):
+        """Device-corpus engine chunk size (positions per step); smaller
+        chunks = finer step granularity (see nlp/distributed.py)."""
+        return self._set("chunk", int(n))
+
+    def mesh(self, mesh):
+        """Train data-parallel over a jax.sharding.Mesh (the
+        dl4j-spark-nlp Word2Vec role); implies the device-corpus
+        engine."""
+        return self._set("mesh", mesh)
+
+    def device_corpus(self, b=True):
+        """Use the device-resident-corpus engine on one chip (device-side
+        pair generation; nlp/distributed.py)."""
+        return self._set("device_corpus", bool(b))
 
     def build(self) -> Word2Vec:
         if "iterate" not in self._kw:

@@ -97,22 +97,6 @@ void one_hot_f32(const int32_t* labels, float* out, int64_t n,
     }
 }
 
-// CHW planar pixels -> HWC interleaved (CIFAR binary records and other
-// channels-first sources feeding the NHWC train step).
-void u8_chw_to_hwc(const uint8_t* src, uint8_t* dst, int64_t c, int64_t h,
-                   int64_t w) {
-    const int64_t plane = h * w;
-    for (int64_t y = 0; y < h; ++y) {
-        for (int64_t x = 0; x < w; ++x) {
-            const int64_t px = y * w + x;
-            uint8_t* d = dst + px * c;
-            for (int64_t ch = 0; ch < c; ++ch) {
-                d[ch] = src[ch * plane + px];
-            }
-        }
-    }
-}
-
 // Bilinear resize of an HWC uint8 image (ImageRecordReader's
 // scale-to-network-input step; half-pixel-center sampling like OpenCV's
 // INTER_LINEAR, which is what DataVec's NativeImageLoader uses).

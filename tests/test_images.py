@@ -44,12 +44,6 @@ class TestPnm:
 
 
 class TestNativeImageKernels:
-    def test_chw_to_hwc_matches_transpose(self):
-        rng = np.random.default_rng(2)
-        img = rng.integers(0, 255, (3, 5, 8), dtype=np.uint8)
-        np.testing.assert_array_equal(native_etl.chw_to_hwc(img),
-                                      img.transpose(1, 2, 0))
-
     def test_resize_native_vs_numpy_paths(self):
         rng = np.random.default_rng(3)
         img = rng.integers(0, 255, (32, 40, 3), dtype=np.uint8)
