@@ -205,8 +205,7 @@ def read_cifar_bin(path: str) -> Tuple[np.ndarray, np.ndarray]:
     recs = raw.reshape(-1, CIFAR_RECORD_BYTES)
     labels = recs[:, 0].copy()
     chw = recs[:, 1:].reshape(-1, 3, 32, 32)
-    # whole-batch vectorized transpose: one numpy op beats 50k per-image
-    # ctypes calls (the native chw_to_hwc kernel is for per-image paths)
+    # whole-batch vectorized transpose: one numpy op over all records
     imgs = np.ascontiguousarray(chw.transpose(0, 2, 3, 1))
     return imgs, labels
 

@@ -61,13 +61,12 @@ def _count_scale(grad, idx, weights):
 
 
 def _make_superstep(window: int, negative: int, chunk: int,
-                    steps_per_call: int,
                     mesh: Optional[jax.sharding.Mesh] = None):
-    """Build the jitted multi-chunk training function. All shape-bearing
-    hyperparameters are baked in statically. Under a mesh, the chunk
-    (position) axis is sharded across `axis` — tables stay replicated
-    and GSPMD inserts the gradient all-reduce (the accumulator-merge of
-    the reference's FirstIterationFunction)."""
+    """Build the jitted multi-chunk training function (steps per call =
+    the length of the scanned starts/lrs arrays). Under a mesh, the
+    chunk (position) axis is sharded — tables stay replicated and GSPMD
+    inserts the gradient all-reduce (the accumulator-merge of the
+    reference's FirstIterationFunction)."""
     offs = np.concatenate([np.arange(-window, 0),
                            np.arange(1, window + 1)]).astype(np.int32)
 
@@ -200,8 +199,7 @@ class ShardedWord2Vec:
             raise ValueError(f"chunk={self.chunk} must divide evenly over "
                              f"the {mesh.size}-device mesh")
         self._step_fn = _make_superstep(self.window, self.negative,
-                                        self.chunk, self.steps_per_call,
-                                        mesh=mesh)
+                                        self.chunk, mesh=mesh)
         self._key = jax.random.PRNGKey(seed + 1)
         self.last_losses = None
 
@@ -212,12 +210,16 @@ class ShardedWord2Vec:
             raise ValueError("token_ids/sent_ids must be equal 1-D arrays")
         # the corpus is device-RESIDENT by contract: upload once and keep
         # (repeat fit_corpus calls — epochs, benchmarks — must not re-ship
-        # it through the host link)
-        key = (token_ids.ctypes.data, token_ids.shape, sent_ids.ctypes.data)
-        if getattr(self, "_corpus_key", None) != key:
+        # it through the host link). Identity is decided by CONTENT: a
+        # pointer-based key falsely cache-hits when numpy reallocates a
+        # fresh same-sized corpus at a freed buffer's address.
+        cached = getattr(self, "_corpus_host", None)
+        if cached is None or not (
+                np.array_equal(cached[0], token_ids)
+                and np.array_equal(cached[1], sent_ids)):
             self._corpus_dev = (jnp.asarray(token_ids),
                                 jnp.asarray(sent_ids))
-            self._corpus_key = key
+            self._corpus_host = (token_ids.copy(), sent_ids.copy())
         return self._corpus_dev
 
     def fit_corpus(self, token_ids: np.ndarray, sent_ids: np.ndarray,

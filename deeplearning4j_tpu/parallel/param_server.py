@@ -1,0 +1,233 @@
+"""Asynchronous parameter-server data parallelism.
+
+Reference parity: the third parallelism flavor —
+`parallelism/parameterserver/ParameterServerTrainer{,Context}.java:43-66`
+swaps ParallelWrapper's DefaultTrainer for workers that PUSH gradients
+to / PULL parameters from an Aeron-UDP ParameterServerNode, with no
+averaging barrier; `dl4j-spark-parameterserver`'s
+ParameterServerTrainingHook plays the same role on Spark workers.
+
+TPU-native redesign: the server is an in-process parameter host pinned
+to one device; the transport is shared memory + a lock instead of Aeron
+UDP (the reference's media driver is usually in-process too). Worker
+threads each own a device, loop pull → jitted gradient step → push with
+NO barrier between workers, and the server applies each push through
+the model's own updater chain (gradient normalization included) the
+moment it arrives. Python threads work here because every hot segment —
+device-to-device parameter copies, the jitted gradient computation, the
+jitted server update — releases the GIL.
+
+Staleness: pushes carry the parameter version they were computed at.
+The server applies a push only if `current - version <= max_staleness`
+and DROPS it otherwise (the worker just re-pulls) — bounded-staleness
+async SGD. `max_staleness=0` forces every applied gradient to be
+computed on the latest parameters (serialized, losing async throughput
+but maximally fresh); large values approach unbounded Hogwild. Dropped
+counts are reported on the server for observability.
+"""
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.iterators import as_iterator
+from ..nn.multilayer import MultiLayerNetwork
+from ..nn.updaters import normalize_layer_gradients
+
+log = logging.getLogger(__name__)
+
+
+class ParameterServer:
+    """In-process parameter host (ParameterServerNode role)."""
+
+    def __init__(self, net: MultiLayerNetwork, max_staleness: int = 2,
+                 device: Optional[jax.Device] = None):
+        self._net = net
+        self.device = device or jax.local_devices()[0]
+        self.max_staleness = int(max_staleness)
+        self._lock = threading.Lock()
+        self.version = 0
+        self.stale_drops = 0
+        self.applied = 0
+        self.params = jax.device_put(net.params_tree, self.device)
+        self.opt_state = jax.device_put(net.opt_state, self.device)
+        layers = net.layers
+
+        def apply_update(params, opt_state, iteration, grads):
+            new_params, new_opt = [], []
+            for i, layer in enumerate(layers):
+                g = normalize_layer_gradients(
+                    grads[i], layer.gradient_normalization,
+                    layer.gradient_normalization_threshold)
+                updates, opt_i = layer.updater.update(
+                    g, opt_state[i], iteration)
+                if layer.frozen:
+                    new_params.append(params[i])
+                    new_opt.append(opt_state[i])
+                else:
+                    new_params.append(jax.tree_util.tree_map(
+                        lambda p, u: p - u.astype(p.dtype), params[i],
+                        updates))
+                    new_opt.append(opt_i)
+            return tuple(new_params), tuple(new_opt)
+
+        # NO buffer donation here: pull() hands out references to the
+        # live param buffers, and a donated apply would delete them under
+        # a concurrently-computing worker ("Array has been deleted").
+        self._apply = jax.jit(apply_update)
+
+    def pull(self, device: Optional[jax.Device] = None):
+        """Current (version, params) — params copied to the worker's
+        device (the ParameterServerClient.getParams round trip)."""
+        with self._lock:
+            params, version = self.params, self.version
+        if device is not None and device != self.device:
+            params = jax.device_put(params, device)
+        return version, params
+
+    def push(self, version: int, grads) -> bool:
+        """Apply a gradient computed at `version`; False = dropped as
+        too stale (worker should re-pull and retry on fresh params)."""
+        with self._lock:
+            if self.version - version > self.max_staleness:
+                self.stale_drops += 1
+                return False
+            grads = jax.device_put(grads, self.device)
+            self.params, self.opt_state = self._apply(
+                self.params, self.opt_state,
+                jnp.asarray(self.version, jnp.int32), grads)
+            self.version += 1
+            self.applied += 1
+            return True
+
+
+class ParameterServerTrainer:
+    """Async DP fit loop (ParameterServerTrainerContext role): one
+    worker thread per device, round-robin minibatch feed, no barrier."""
+
+    def __init__(self, net: MultiLayerNetwork,
+                 workers: Optional[int] = None,
+                 devices: Optional[List[jax.Device]] = None,
+                 max_staleness: int = 2, queue_size: int = 4):
+        if not isinstance(net, MultiLayerNetwork):
+            raise NotImplementedError(
+                "ParameterServerTrainer drives MultiLayerNetwork; use "
+                "ParallelWrapper for ComputationGraph data parallelism")
+        net._check_init()
+        if any(len(st) for st in net.state_tree):
+            # BN running stats etc. have no well-defined owner under
+            # asynchronous updates (whose statistics win?); the sync
+            # paths commit state, this one cannot — reject loudly
+            raise NotImplementedError(
+                "async parameter-server training does not support "
+                "stateful layers (e.g. BatchNormalization running "
+                "statistics); use ParallelWrapper")
+        self.net = net
+        devs = devices or jax.local_devices()
+        n = workers or len(devs)
+        # workers may outnumber devices (thread-level async on one chip,
+        # exactly the reference's threads-per-GPU knob)
+        self.devices = [devs[i % len(devs)] for i in range(n)]
+        self.server = ParameterServer(net, max_staleness=max_staleness)
+        self.queue_size = int(queue_size)
+        self.losses: List[float] = []
+
+        def loss_and_grads(params, state, rng, x, y, fmask, lmask):
+            (loss, _), grads = jax.value_and_grad(
+                net._loss_pure, has_aux=True)(
+                    params, state, x, y, fmask, lmask, rng, True)
+            return loss, grads
+
+        self._grad_fn = jax.jit(loss_and_grads)
+
+    def _worker(self, wid: int, q: "queue.Queue", errors: list,
+                stop: threading.Event):
+        dev = self.devices[wid]
+        rng = jax.random.PRNGKey(1000 + wid)
+        state = jax.device_put(self.net.state_tree, dev)
+        try:
+            while not stop.is_set():
+                try:
+                    item = q.get(timeout=0.2)
+                except queue.Empty:
+                    continue
+                if item is None:
+                    return
+                x, y, fmask, lmask = item
+                x = jax.device_put(x, dev)
+                y = jax.device_put(y, dev)
+                while True:
+                    version, params = self.server.pull(dev)
+                    rng, sub = jax.random.split(rng)
+                    loss, grads = self._grad_fn(params, state, sub, x, y,
+                                                fmask, lmask)
+                    if self.server.push(version, grads):
+                        self.losses.append(float(loss))
+                        break
+                    # dropped as stale: re-pull fresh params and redo
+        except Exception as e:  # surfaced by fit(); a dead worker must
+            errors.append(e)   # not silently hang the queue
+            log.exception("parameter-server worker %d died", wid)
+
+    def fit(self, data, labels=None, *, epochs: int = 1,
+            batch_size: int = 32) -> "ParameterServerTrainer":
+        it = as_iterator(data, labels, batch_size)
+        q: "queue.Queue" = queue.Queue(maxsize=self.queue_size)
+        errors: list = []
+        stop = threading.Event()
+        threads = [threading.Thread(target=self._worker,
+                                    args=(i, q, errors, stop), daemon=True)
+                   for i in range(len(self.devices))]
+        for t in threads:
+            t.start()
+
+        def put_checked(item):
+            # bounded put that keeps checking worker health: a plain
+            # blocking put deadlocks forever if all workers die with the
+            # queue full (nobody left to drain it)
+            while True:
+                if errors:
+                    raise RuntimeError(
+                        "parameter-server worker failed") from errors[0]
+                try:
+                    q.put(item, timeout=0.2)
+                    return
+                except queue.Full:
+                    continue
+
+        try:
+            for _ in range(epochs):
+                it.reset()
+                for ds in it:
+                    put_checked(
+                        (np.asarray(ds.features), np.asarray(ds.labels),
+                         None if ds.features_mask is None
+                         else np.asarray(ds.features_mask),
+                         None if ds.labels_mask is None
+                         else np.asarray(ds.labels_mask)))
+            for _ in threads:
+                put_checked(None)  # graceful drain: workers finish the
+            for t in threads:      # queue before seeing their sentinel
+                t.join()
+        finally:
+            stop.set()  # error path: abort workers mid-queue
+            for t in threads:
+                t.join()
+        if errors:
+            raise RuntimeError("parameter-server worker failed") \
+                from errors[0]
+        # commit the server's latest state back into the network
+        self.net.params_tree = jax.device_put(
+            self.server.params, jax.local_devices()[0])
+        self.net.opt_state = jax.device_put(
+            self.server.opt_state, jax.local_devices()[0])
+        self.net.iteration = self.server.version
+        if self.losses:
+            self.net.score_value = jnp.asarray(self.losses[-1])
+        return self

@@ -167,3 +167,20 @@ class TestFacadeIntegration:
         with pytest.raises(ValueError, match="negative"):
             (Word2Vec.builder().iterate(["a b c"])
              .device_corpus().build().fit())
+
+
+def test_corpus_cache_keys_on_content():
+    """A fresh same-shaped corpus (possibly reallocated at the same host
+    address) must re-upload — content decides identity."""
+    cache, indexed = _cluster_corpus(n_sent=40, seed=3)
+    toks, sids = corpus_arrays(indexed)
+    tr = ShardedWord2Vec(cache, layer_size=8, window=2, negative=2,
+                         chunk=256, steps_per_call=1, seed=1)
+    c1 = tr._device_corpus(toks, sids)
+    c1b = tr._device_corpus(toks.copy(), sids.copy())
+    assert c1[0] is c1b[0]  # same content -> cached device buffers
+    toks2 = toks.copy()
+    toks2[0] = (toks2[0] + 1) % len(cache)
+    c2 = tr._device_corpus(toks2, sids)
+    assert c2[0] is not c1[0]
+    assert int(np.asarray(c2[0][0])) == int(toks2[0])
