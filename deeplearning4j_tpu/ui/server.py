@@ -1,0 +1,126 @@
+"""Live training UI server — attach a StatsStorage and watch while fit()
+runs.
+
+Reference parity: deeplearning4j-play's PlayUIServer
+(`ui/play/PlayUIServer.java:15-22`): `UIServer.getInstance()`,
+`attach(statsStorage)`, pluggable modules (train overview, histograms,
+update magnitudes), browse while training. Here the Play framework is a
+stdlib ThreadingHTTPServer; every page request re-renders from the
+attached storage, so the browser always sees the CURRENT run state, and
+the page self-refreshes (watch mode). The remote-receiver module
+counterpart lives in ui/remote.py (POST /stats); both can share one
+storage so cluster workers report into the same live view.
+
+Routes:
+  GET /                  live HTML overview (self-refreshing)
+  GET /train/sessions    JSON session ids
+  GET /train/data        JSON all updates of the newest session
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..utils.http_server import JsonHttpServer
+from .report import render_html
+from .stats import StatsStorage
+
+
+class UIServer:
+    """PlayUIServer role; one instance per process via get_instance()."""
+
+    _instance: Optional["UIServer"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self, port: int = 0, refresh_seconds: float = 2.0):
+        self._storages: list[StatsStorage] = []
+        self._lock = threading.Lock()
+        self.refresh_seconds = float(refresh_seconds)
+        self._server = JsonHttpServer(
+            get_routes={"/train/sessions": self._sessions,
+                        "/train/data": self._data},
+            post_routes={},
+            raw_get_routes={"/": self._index},
+            port=port)
+
+    # ----------------------------------------------------------- lifecycle
+    @classmethod
+    def get_instance(cls, port: int = 0) -> "UIServer":
+        """Reference UIServer.getInstance(): lazily start the singleton."""
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls(port=port).start()
+            return cls._instance
+
+    def start(self) -> "UIServer":
+        self._server.start()
+        return self
+
+    def stop(self):
+        self._server.stop()
+        with UIServer._instance_lock:
+            if UIServer._instance is self:
+                UIServer._instance = None
+
+    @property
+    def url(self) -> str:
+        return self._server.url
+
+    @property
+    def port(self) -> int:
+        return self._server.port
+
+    # -------------------------------------------------------------- attach
+    def attach(self, storage: StatsStorage) -> "UIServer":
+        """Reference UIServer.attach(statsStorage): pages render from the
+        newest session across all attached storages from now on."""
+        with self._lock:
+            if storage not in self._storages:
+                self._storages.append(storage)
+        return self
+
+    def detach(self, storage: StatsStorage) -> "UIServer":
+        with self._lock:
+            if storage in self._storages:
+                self._storages.remove(storage)
+        return self
+
+    def _pick(self):
+        """(storage, session_id) of the most recently updated session."""
+        with self._lock:
+            storages = list(self._storages)
+        best = None
+        for st in storages:
+            for sid in st.list_session_ids():
+                updates = st.get_updates(sid)
+                if not updates:
+                    continue
+                ts = updates[-1].get("timestamp", 0)
+                if best is None or ts > best[2]:
+                    best = (st, sid, ts)
+        return (best[0], best[1]) if best else (None, None)
+
+    # -------------------------------------------------------------- routes
+    def _index(self):
+        st, sid = self._pick()
+        if st is None:
+            body = (b"<!doctype html><meta http-equiv='refresh' "
+                    b"content='2'><body>waiting for an attached "
+                    b"StatsStorage with updates...</body>")
+            return 200, "text/html; charset=utf-8", body
+        doc = render_html(st, sid, refresh_seconds=self.refresh_seconds)
+        return 200, "text/html; charset=utf-8", doc.encode()
+
+    def _sessions(self, _):
+        with self._lock:
+            storages = list(self._storages)
+        out = []
+        for st in storages:
+            out.extend(st.list_session_ids())
+        return 200, {"sessions": out}
+
+    def _data(self, _):
+        st, sid = self._pick()
+        if st is None:
+            return 404, {"error": "no attached session"}
+        return 200, {"session": sid, "updates": st.get_updates(sid)}

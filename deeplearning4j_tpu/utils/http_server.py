@@ -17,9 +17,13 @@ class JsonHttpServer:
     Handler exceptions become 400s (client-visible, server stays up)."""
 
     def __init__(self, get_routes: Routes, post_routes: Routes,
-                 port: int = 0, host: str = "127.0.0.1"):
+                 port: int = 0, host: str = "127.0.0.1",
+                 raw_get_routes: Optional[Routes] = None):
         self._get = dict(get_routes)
         self._post = dict(post_routes)
+        # raw routes return (status, content_type, body_bytes) — the live
+        # UI serves HTML through these; JSON routes stay JSON
+        self._raw_get = dict(raw_get_routes or {})
         self._port = int(port)
         self._host = host
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -35,6 +39,7 @@ class JsonHttpServer:
 
     def start(self):
         get_routes, post_routes = self._get, self._post
+        raw_get_routes = self._raw_get
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):  # quiet
@@ -59,6 +64,19 @@ class JsonHttpServer:
                     self._json(400, {"error": str(e)})
 
             def do_GET(self):
+                raw = raw_get_routes.get(self.path)
+                if raw is not None:
+                    try:
+                        code, ctype, body = raw()
+                    except Exception as e:
+                        self._json(400, {"error": str(e)})
+                        return
+                    self.send_response(code)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 self._dispatch(get_routes, None)
 
             def do_POST(self):

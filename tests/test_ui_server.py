@@ -1,0 +1,142 @@
+"""Live UI server (VERDICT r2 item 5: attach-and-watch while fit()
+runs, PlayUIServer.java:15-22 role) + histogram/update views."""
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+from deeplearning4j_tpu import (Adam, DataSet, DenseLayer, InputType,
+                                MultiLayerNetwork, NeuralNetConfiguration,
+                                OutputLayer)
+from deeplearning4j_tpu.ui.report import render_html
+from deeplearning4j_tpu.ui.server import UIServer
+from deeplearning4j_tpu.ui.stats import (InMemoryStatsStorage,
+                                         StatsListener,
+                                         StatsUpdateConfiguration)
+
+
+def _net():
+    conf = (NeuralNetConfiguration.builder().seed(3).updater(Adam(0.01))
+            .list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.read()
+
+
+class TestUIServer:
+    def test_live_attach_while_training(self):
+        """Boot the server MID-TRAINING and assert the served page
+        reflects new updates as fit() progresses — the attach-and-watch
+        contract."""
+        storage = InMemoryStatsStorage()
+        net = _net()
+        net.listeners.append(StatsListener(
+            storage, config=StatsUpdateConfiguration(
+                collect_histograms=True, collect_updates=True)))
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((64, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 64)]
+
+        server = UIServer(port=0).start()
+        try:
+            server.attach(storage)
+            # slow trainer thread: one batch at a time
+            stop = threading.Event()
+
+            def train():
+                for _ in range(60):
+                    if stop.is_set():
+                        return
+                    net._fit_batch(DataSet(x, y))
+                    time.sleep(0.01)
+
+            t = threading.Thread(target=train, daemon=True)
+            t.start()
+            try:
+                # first poll: wait for any updates
+                deadline = time.time() + 30
+                n1 = 0
+                while time.time() < deadline and n1 == 0:
+                    data = json.loads(_get(server.url + "/train/data")
+                                      .decode()) \
+                        if storage.list_session_ids() else {"updates": []}
+                    n1 = len(data.get("updates", []))
+                    time.sleep(0.05)
+                assert n1 > 0
+                # second poll mid-run: MORE updates must have appeared
+                deadline = time.time() + 30
+                n2 = n1
+                while time.time() < deadline and n2 <= n1:
+                    data = json.loads(_get(server.url + "/train/data")
+                                      .decode())
+                    n2 = len(data["updates"])
+                    time.sleep(0.05)
+                assert n2 > n1, "no live progress visible through the UI"
+                page = _get(server.url + "/").decode()
+                assert "Training report (live)" in page
+                assert 'http-equiv="refresh"' in page
+                assert "Parameter histograms" in page
+                assert "Update mean magnitudes" in page
+                assert "<svg" in page
+            finally:
+                stop.set()
+                t.join()
+        finally:
+            server.stop()
+
+    def test_sessions_route_and_detach(self):
+        storage = InMemoryStatsStorage()
+        storage.put_update("sess-a", {"iteration": 1, "score": 0.5,
+                                      "timestamp": time.time()})
+        server = UIServer(port=0).start()
+        try:
+            server.attach(storage)
+            sess = json.loads(_get(server.url + "/train/sessions").decode())
+            assert sess["sessions"] == ["sess-a"]
+            page = _get(server.url + "/").decode()
+            assert "sess-a" in page
+            server.detach(storage)
+            page = _get(server.url + "/").decode()
+            assert "waiting for an attached" in page
+        finally:
+            server.stop()
+
+    def test_get_instance_singleton(self):
+        a = UIServer.get_instance()
+        try:
+            assert UIServer.get_instance() is a
+        finally:
+            a.stop()
+        b = UIServer.get_instance()
+        try:
+            assert b is not a
+        finally:
+            b.stop()
+
+    def test_static_report_has_histograms(self, tmp_path):
+        storage = InMemoryStatsStorage()
+        storage.put_update("s", {
+            "iteration": 1, "score": 1.0, "timestamp": time.time(),
+            "param_histograms": {
+                "layer0/W": {"counts": [1, 5, 2], "min": -1.0, "max": 1.0}},
+            "update_mean_magnitudes": {"layer0/W": 0.01}})
+        storage.put_update("s", {
+            "iteration": 2, "score": 0.9, "timestamp": time.time(),
+            "update_mean_magnitudes": {"layer0/W": 0.02}})
+        doc = render_html(storage)
+        assert "Parameter histograms" in doc
+        assert "Update mean magnitudes" in doc
+        # static render has NO refresh; live render does
+        assert 'http-equiv="refresh"' not in doc
+        assert 'http-equiv="refresh"' in render_html(
+            storage, refresh_seconds=1.0)
