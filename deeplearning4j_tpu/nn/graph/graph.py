@@ -339,15 +339,20 @@ class ComputationGraph(DeviceIterationMixin):
                 wrapped.shutdown()
         return self
 
-    def fit_batch(self, mds: MultiDataSet):
+    def fit_batch(self, mds: MultiDataSet, do_step=None):
+        """One training batch. `do_step(inputs, labels, fmasks, lmasks)`
+        lets ParallelWrapper substitute a sharded step while REUSING the
+        tBPTT windowing below (the MultiLayerNetwork._fit_batch do_step
+        contract)."""
         mds = self._coerce(mds)
+        do_step = do_step or self._run_and_commit
         if self.conf.backprop_type == BackpropType.TRUNCATED_BPTT:
             # ANY rank-3 input triggers windowing (static rank-2 inputs
             # pass whole into every window — _fit_tbptt handles the mix).
             any_seq = any(np.asarray(f).ndim == 3 for f in mds.features)
             labels_rank3 = all(np.asarray(l).ndim == 3 for l in mds.labels)
             if any_seq and labels_rank3:
-                self._fit_tbptt(mds)
+                self._fit_tbptt(mds, do_step)
                 return
             if not getattr(self, "_warned_tbptt_labels", False):
                 import logging
@@ -356,7 +361,7 @@ class ComputationGraph(DeviceIterationMixin):
                     "using standard BPTT")
                 self._warned_tbptt_labels = True
         self._rnn_carry = None  # standard BPTT: every batch starts fresh
-        self._run_and_commit(*self._pack(mds))
+        do_step(*self._pack(mds))
 
     def fit_batches(self, batches: Sequence) -> "ComputationGraph":
         """K optimizer steps over K minibatches in ONE device dispatch
@@ -409,13 +414,14 @@ class ComputationGraph(DeviceIterationMixin):
                         self, self._iteration - steps + k + 1)
             self.score_value = losses[-1]
 
-    def _fit_tbptt(self, mds: MultiDataSet):
+    def _fit_tbptt(self, mds: MultiDataSet, do_step=None):
         """Truncated BPTT over the graph: slide tbptt_fwd_length windows
         over the time axis of every rank-3 array, one optimizer step per
         window with recurrent state carried between windows (the
         MultiLayerNetwork._fit_tbptt analog; reference ComputationGraph
         doTruncatedBPTT). Rank-2 (static) inputs pass whole into every
         window."""
+        do_step = do_step or self._run_and_commit
         T = max(np.asarray(f).shape[1] for f in mds.features
                 if np.asarray(f).ndim == 3)
         L = self.conf.tbptt_fwd_length
@@ -435,7 +441,7 @@ class ComputationGraph(DeviceIterationMixin):
                 [sl3(m, start, end) for m in mds.features_masks],
                 None if mds.labels_masks is None else
                 [sl3(m, start, end) for m in mds.labels_masks])
-            self._run_and_commit(*self._pack(win))
+            do_step(*self._pack(win))
         self.rnn_clear_previous_state()
 
     # ------------------------------------------------------------- rnn state

@@ -201,20 +201,30 @@ class ParallelWrapper:
             net._check_init()
             self._place_model()
         if hasattr(net, "_pack"):  # ComputationGraph
-            from ..nn.conf.builders import BackpropType
-            if net.conf.backprop_type == BackpropType.TRUNCATED_BPTT:
-                # This path calls _run_and_commit directly and would
-                # silently skip the graph's tBPTT windowing.
-                raise NotImplementedError(
-                    "ParallelWrapper does not support ComputationGraph "
-                    "truncated BPTT yet; train single-device or use "
-                    "standard backprop")
-            inputs, labels, fm, lm, _ = self._prep_graph_batch(ds)
-            shard = lambda d: {k: self._shard_arr(v) for k, v in d.items()}
-            net._run_and_commit(shard(inputs), shard(labels), shard(fm),
-                                shard(lm), mesh=self.mesh)
+            # reuse the graph's own dispatch (tBPTT windowing included)
+            # with the sharded step substituted — the MLN do_step pattern
+            net.fit_batch(net._coerce(ds), do_step=self._sync_graph_step)
             return
         net._fit_batch(ds, do_step=self._sync_step)
+
+    def _sync_graph_step(self, inputs, labels, fm, lm):
+        """Sharded analog of ComputationGraph._run_and_commit for one
+        (possibly tBPTT-windowed) packed batch."""
+        net = self.model
+        n = next(iter(inputs.values())).shape[0]
+        if self.multiprocess:
+            self._check_local_divisible(n)
+        elif n % self.data_shards != 0:
+            if net._rnn_carry is not None:
+                # the recurrent carry is sized to the true batch; padding
+                # the data but not the carry would shape-mismatch in jit
+                raise ValueError(
+                    f"truncated-BPTT batch size {n} must divide the "
+                    f"{self.data_shards}-way data mesh")
+            lm = {name: self._pad_lmask(lm.get(name), n) for name in labels}
+        shard = lambda d: {k: self._shard_arr(v) for k, v in d.items()}
+        net._run_and_commit(shard(inputs), shard(labels), shard(fm),
+                            shard(lm), mesh=self.mesh)
 
     def _prep_graph_batch(self, ds):
         """Pack a (Multi)DataSet for the graph and zero-weight any pad rows
@@ -238,6 +248,10 @@ class ParallelWrapper:
         if self.multiprocess:
             self._check_local_divisible(x.shape[0])
         elif x.shape[0] % self.data_shards != 0:
+            if net._rnn_carry is not None:
+                raise ValueError(
+                    f"truncated-BPTT batch size {x.shape[0]} must divide "
+                    f"the {self.data_shards}-way data mesh")
             lmask = self._pad_lmask(lmask, x.shape[0])
         net._run_and_commit(
             self._shard_arr(x, cast_dtype=net._dtype), self._shard_arr(y),
@@ -263,12 +277,27 @@ class ParallelWrapper:
         def stack(t):  # replicate net trees onto the replica axis
             return tmap(lambda a: jnp.broadcast_to(a[None], (W,) + a.shape), t)
 
+        def avg_one(a):
+            m = jnp.mean(a, axis=0) if jnp.issubdtype(a.dtype, jnp.floating) \
+                else a[0]
+            return jnp.broadcast_to(m[None], a.shape)
+
         def avg(t):  # averageAndPropagate: mean over replicas, re-broadcast
-            def one(a):
-                m = jnp.mean(a, axis=0) if jnp.issubdtype(a.dtype, jnp.floating) \
-                    else a[0]
-                return jnp.broadcast_to(m[None], a.shape)
-            return tmap(one, t)
+            return tmap(avg_one, t)
+
+        def avg_keep_carry(t):
+            # tBPTT variant: params/opt/BN-stats average, but each
+            # replica's recurrent carry (h/c) belongs to ITS data shard
+            # and must never be averaged across replicas
+            params, opt, state = t
+            state = tuple(
+                {k: (v if k in ("h", "c") else avg_one(v))
+                 for k, v in st.items()} for st in state)
+            return tmap(avg_one, params), tmap(avg_one, opt), state
+
+        def strip_carry(state):
+            return tuple({k: v for k, v in st.items()
+                          if k not in ("h", "c")} for st in state)
 
         def take0(t):  # replicas are equal post-average; unstack view
             return tmap(lambda a: a[0], t)
@@ -278,6 +307,9 @@ class ParallelWrapper:
         self._jit_helpers = {
             "stack": jax.jit(stack, out_shardings=stacked_sh),
             "avg": jax.jit(avg, out_shardings=stacked_sh),
+            "avg_keep_carry": jax.jit(avg_keep_carry,
+                                      out_shardings=stacked_sh),
+            "strip_carry": jax.jit(strip_carry, out_shardings=stacked_sh),
             "take0": jax.jit(take0,
                              out_shardings=mesh_lib.replicated(self.mesh)),
             "split_rngs": jax.jit(lambda k: jax.random.split(k, W),
@@ -345,17 +377,25 @@ class ParallelWrapper:
         net = self.model
         net._check_init()
         if hasattr(net, "_pack"):  # ComputationGraph
+            from ..nn.conf.builders import BackpropType
+            if net.conf.backprop_type == BackpropType.TRUNCATED_BPTT:
+                # _local_round_tbptt implements the windowed carry for
+                # MultiLayerNetwork only; a silent whole-sequence step
+                # here would diverge from single-device training
+                raise NotImplementedError(
+                    "ComputationGraph truncated BPTT with "
+                    "averaging_frequency > 1 is not supported; use "
+                    "averaging_frequency=1 (synchronous DP)")
             inputs, labels, fm, lm, n = self._prep_graph_batch(ds)
             data = tuple({k: self._stack_data(v, n) for k, v in d.items()}
                          for d in (inputs, labels, fm, lm))
         else:
             from ..nn.conf.builders import BackpropType
             if net.conf.backprop_type == BackpropType.TRUNCATED_BPTT and \
-                    np.asarray(ds.features).ndim == 3:
-                raise NotImplementedError(
-                    "tBPTT with averaging_frequency > 1 is not supported; "
-                    "use averaging_frequency=1 (synchronous DP) for "
-                    "truncated-BPTT models")
+                    np.asarray(ds.features).ndim == 3 and \
+                    np.asarray(ds.labels).ndim == 3:
+                self._local_round_tbptt(ds)
+                return
             x, y = ds.features, ds.labels
             fmask, lmask = ds.features_mask, ds.labels_mask
             n = np.asarray(x).shape[0]
@@ -389,6 +429,71 @@ class ParallelWrapper:
         self._sync_net_from_stacked()
         for lst in net.listeners:
             lst.iteration_done(net, net.iteration)
+
+    def _local_round_tbptt(self, ds) -> None:
+        """Local SGD over a truncated-BPTT batch (MultiLayerNetwork):
+        every replica runs the SAME window schedule on its shard of the
+        batch, with the recurrent carry riding the replica-stacked state
+        between windows — one optimizer step per window per replica,
+        averaging every F windows (matching how a reference worker would
+        count its tBPTT iterations)."""
+        net = self.model
+        x = np.asarray(ds.features)
+        n = x.shape[0]
+        if self.multiprocess:
+            self._check_local_divisible(n)
+        elif n % self.data_shards != 0:
+            raise ValueError(
+                f"truncated-BPTT batch size {n} must divide the "
+                f"{self.data_shards}-way data mesh")
+        chunk = (n // self.local_shards if self.multiprocess
+                 else n // self.data_shards)
+        # seed the carry at per-replica chunk size, then (re)stack the
+        # state so every replica starts this batch with zero h/c
+        net.rnn_clear_previous_state()
+        net._seed_recurrent_states(chunk)
+        self._ensure_stacked(4)
+        params, opt, _ = self._stacked
+        with self.mesh:
+            state = self._jit_helpers["stack"](net._merged_state())
+        self._stacked = (params, opt, state)
+        T = x.shape[1]
+        L = net.conf.tbptt_fwd_length
+        y = np.asarray(ds.labels)
+        fmask = None if ds.features_mask is None \
+            else np.asarray(ds.features_mask)
+        lmask = None if ds.labels_mask is None \
+            else np.asarray(ds.labels_mask)
+        xc = x.astype(np.dtype(net._dtype)) if x.dtype.kind == "f" else x
+        for start in range(0, T, L):
+            end = min(start + L, T)
+            data = tuple(
+                self._stack_data(None if a is None else a[:, start:end], n)
+                for a in (xc, y, fmask, lmask))
+            params, opt, state = self._stacked
+            with self.mesh:
+                (params, opt, state, _, self._stacked_rngs,
+                 losses) = self._stacked_step(
+                    params, opt, state,
+                    jnp.asarray(net.iteration, jnp.int32),
+                    self._stacked_rngs, *data)
+            self._stacked = (params, opt, state)
+            self._since_avg += 1
+            net.iteration += 1
+            net.score_value = jnp.mean(losses)
+            if self._since_avg >= self.averaging_frequency:
+                self._stacked = self._jit_helpers["avg_keep_carry"](
+                    self._stacked)
+                self._since_avg = 0
+            self._sync_net_from_stacked()
+            for lst in net.listeners:
+                lst.iteration_done(net, net.iteration)
+        # batch over: drop the carry (net + next batch reseeds the stack)
+        net.rnn_clear_previous_state()
+        params, opt, state = self._stacked
+        with self.mesh:
+            self._stacked = (params, opt,
+                             self._jit_helpers["strip_carry"](state))
 
     def _sync_net_from_stacked(self):
         net = self.model

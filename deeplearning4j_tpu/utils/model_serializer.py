@@ -33,6 +33,7 @@ PARAMS_ENTRY = "coefficients.npz"
 UPDATER_ENTRY = "updaterState.npz"
 STATE_ENTRY = "state.npz"
 NORMALIZER_ENTRY = "normalizer.json"
+RNG_ENTRY = "rngState.npz"  # round 3: exact resume for rng-consuming nets
 
 
 def _tree_to_npz_bytes(tree) -> bytes:
@@ -109,6 +110,11 @@ def save_model(model, path: str, save_updater: bool = True,
         zf.writestr(STATE_ENTRY, _tree_to_npz_bytes(model.state_tree))
         if save_updater:
             zf.writestr(UPDATER_ENTRY, _tree_to_npz_bytes(model.opt_state))
+        if model._rng is not None:
+            # the dropout key stream position: without it a resumed run's
+            # post-resume dropout masks diverge from an uninterrupted run
+            zf.writestr(RNG_ENTRY,
+                        _tree_to_npz_bytes(jnp.asarray(model._rng)))
         if normalizer is not None:
             zf.writestr(NORMALIZER_ENTRY, serde.to_json(normalizer))
 
@@ -142,6 +148,9 @@ def restore_model(path: str, load_updater: bool = True):
                                                  model.opt_state)
         model.iteration = meta.get("iteration", 0)
         model.epoch = meta.get("epoch", 0)
+        if RNG_ENTRY in zf.namelist():
+            model._rng = _npz_bytes_to_tree(zf.read(RNG_ENTRY),
+                                            jnp.asarray(model._rng))
     return model
 
 

@@ -1,0 +1,84 @@
+"""Worker for the kill-and-resume elastic test.
+
+    python elastic_worker.py <pid> <nproc> <port> <ckpt_dir> <crash_at>
+
+Trains a 2-process MLN with auto-checkpointing every 2 steps. When
+crash_at >= 0, process 1 hard-exits (os._exit — no cleanup, simulating
+preemption) the moment model.iteration reaches crash_at; the job is
+then restarted by the test with crash_at=-1 and must auto-resume from
+the newest checkpoint to the same final parameters as an uninterrupted
+run. Deterministic: the crash point is a fixed step count, data order
+is fixed, and checkpoints are atomic."""
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_force_host_platform_device_count=2"
+
+pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+ckpt_dir, crash_at = sys.argv[4], int(sys.argv[5])
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from deeplearning4j_tpu import (DenseLayer, InputType, MultiLayerNetwork,  # noqa: E402
+                                NeuralNetConfiguration, Nesterovs,
+                                OutputLayer)
+from deeplearning4j_tpu.parallel import MultiHostRunner  # noqa: E402
+
+
+def build_net():
+    conf = (NeuralNetConfiguration.builder().seed(7)
+            .updater(Nesterovs(0.1, momentum=0.9))
+            .list()
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(8)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+class CrashAt:
+    """Hard-exit THIS process at a fixed optimizer step (preemption)."""
+
+    def __init__(self, step):
+        self.step = step
+
+    def iteration_done(self, model, iteration):
+        if self.step >= 0 and iteration >= self.step:
+            print(f"CRASHING {pid} at {iteration}", flush=True)
+            sys.stdout.flush()
+            os._exit(3)
+
+
+runner = MultiHostRunner(coordinator_address=f"127.0.0.1:{port}",
+                         num_processes=nproc, process_id=pid).initialize()
+
+net = build_net()
+if crash_at >= 0 and pid == 1:
+    net.listeners.append(CrashAt(crash_at))
+
+rng = np.random.default_rng(0)
+x = rng.standard_normal((96, 8)).astype(np.float32)
+y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, size=96)]
+# interleaved partitions (same contract as multihost_worker.partition):
+# global batch b = concat(proc0 rows, proc1 rows)
+xs = x.reshape(6, 16, 8)[:, pid * 8:(pid + 1) * 8].reshape(48, 8)
+ys = y.reshape(6, 16, 3)[:, pid * 8:(pid + 1) * 8].reshape(48, 3)
+
+from deeplearning4j_tpu.parallel.multihost import CheckpointManager  # noqa: E402
+
+latest = CheckpointManager(ckpt_dir).latest()
+print(f"RESUME_FROM {pid} {latest[0] if latest else -1}", flush=True)
+
+# 2 epochs x 6 batches = 12 optimizer steps, checkpoint every 2
+runner.fit(net, xs, ys, epochs=2, batch_size=8,
+           checkpoint_dir=ckpt_dir, checkpoint_every=2)
+runner.materialize_local(net)
+print(f"FINAL {pid} {float(np.abs(net.params()).sum()):.6f} "
+      f"iter={net.iteration}", flush=True)
+runner.barrier("done")
+print(f"DONE {pid}", flush=True)

