@@ -102,11 +102,22 @@ def _conv_mode(cfg: dict):
         f"Unsupported Keras padding {padding!r}")
 
 
-def _require_channels_last(cfg: dict):
-    if cfg.get("data_format", "channels_last") != "channels_last":
+def _check_data_format(cfg: dict, data_format: str):
+    """Every spatial layer must agree with the model-wide ordering the
+    importer detected (mixed-format models are genuinely ambiguous).
+    channels_first itself is SUPPORTED on the sequential path: Keras
+    stores conv kernels HWIO regardless of data_format, so only the
+    input layout and the first dense after a Flatten need conversion
+    (the reference's TensorFlowCnnToFeedForwardPreProcessor role) —
+    both handled by the importer, not here."""
+    # a missing key inherits the detected model-wide ordering (old
+    # Keras Flatten configs carry no data_format at all); only an
+    # EXPLICIT contradiction is a mixed-ordering error
+    fmt = cfg.get("data_format") or data_format
+    if fmt != data_format:
         raise UnsupportedKerasConfigurationException(
-            "channels_first Keras models are not supported; re-save with "
-            "channels_last (this framework is NHWC-native)")
+            f"Layer {cfg.get('name')!r} uses {fmt} but the model was "
+            f"detected as {data_format}; mixed orderings are unsupported")
 
 
 def _dense_weights(w: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
@@ -149,7 +160,8 @@ def _embedding_weights(w: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
 
 
 def map_layer(class_name: str, cfg: dict, *,
-              is_terminal: bool, loss: Optional[str]) -> Mapped:
+              is_terminal: bool, loss: Optional[str],
+              data_format: str = "channels_last") -> Mapped:
     """Translate one Keras layer. `is_terminal` layers with parameters
     become loss heads (OutputLayer) so the imported net is trainable, like
     the reference's enforceTrainingConfig path (KerasModel.java:522-527)."""
@@ -181,13 +193,13 @@ def map_layer(class_name: str, cfg: dict, *,
         # NHWC reshape(batch, -1) == Keras channels_last Flatten; the
         # framework auto-inserts CnnToFeedForward at the next dense layer.
         if class_name == "Flatten":
-            _require_channels_last(cfg)
+            _check_data_format(cfg, data_format)
             return Mapped(skip=True)
         raise UnsupportedKerasConfigurationException(
             "Reshape import is not supported yet")
 
     if class_name in ("Conv2D", "Convolution2D"):
-        _require_channels_last(cfg)
+        _check_data_format(cfg, data_format)
         dil = _pair(cfg.get("dilation_rate", 1))
         return Mapped(conv.ConvolutionLayer(
             name=name, n_out=int(cfg["filters"]),
@@ -197,7 +209,7 @@ def map_layer(class_name: str, cfg: dict, *,
             weights=_dense_weights)
 
     if class_name in ("Conv1D", "Convolution1D"):
-        _require_channels_last(cfg)
+        _check_data_format(cfg, data_format)
         return Mapped(conv.Convolution1DLayer(
             name=name, n_out=int(cfg["filters"]),
             kernel_size=(int(_pair(cfg["kernel_size"])[0]),),
@@ -207,7 +219,7 @@ def map_layer(class_name: str, cfg: dict, *,
             weights=_dense_weights)
 
     if class_name in ("MaxPooling2D", "AveragePooling2D"):
-        _require_channels_last(cfg)
+        _check_data_format(cfg, data_format)
         ptype = conv.PoolingType.MAX if class_name.startswith("Max") \
             else conv.PoolingType.AVG
         pool = _pair(cfg.get("pool_size", 2))
@@ -223,7 +235,7 @@ def map_layer(class_name: str, cfg: dict, *,
         return Mapped(conv.GlobalPoolingLayer(name=name, pooling_type=ptype))
 
     if class_name == "ZeroPadding2D":
-        _require_channels_last(cfg)
+        _check_data_format(cfg, data_format)
         pad = cfg.get("padding", 1)
         if isinstance(pad, (list, tuple)) and pad and \
                 isinstance(pad[0], (list, tuple)):
@@ -238,10 +250,15 @@ def map_layer(class_name: str, cfg: dict, *,
         axis = cfg.get("axis", -1)
         if isinstance(axis, (list, tuple)):
             axis = axis[0]
-        if axis not in (-1, 3, 1):  # -1/3: channels_last; 1: dense feature
+        # channels_last: -1/3 (or 1 for dense features); channels_first:
+        # ONLY axis=1 (the NCHW channel axis) maps to our trailing axis —
+        # -1/3 would be BN over width, silently wrong if accepted
+        ok = (1,) if data_format == "channels_first" else (-1, 3, 1)
+        if axis not in ok:
             raise UnsupportedKerasConfigurationException(
-                f"BatchNormalization over axis {axis} unsupported (feature "
-                "axis must be last)")
+                f"BatchNormalization over axis {axis} unsupported under "
+                f"{data_format} (the feature axis must map to our "
+                "trailing NHWC axis)")
         return Mapped(conv.BatchNormalization(
             name=name, decay=float(cfg.get("momentum", 0.99)),
             eps=float(cfg.get("epsilon", 1e-3))),

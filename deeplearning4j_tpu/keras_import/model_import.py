@@ -29,8 +29,11 @@ from .reader import (Hdf5Archive, InvalidKerasConfigurationException,
                      UnsupportedKerasConfigurationException)
 
 
-def _input_type_from_shape(shape) -> InputType:
-    """batch_shape [None, ...] → InputType (the KerasInput role)."""
+def _input_type_from_shape(shape, data_format="channels_last") -> InputType:
+    """batch_shape [None, ...] → InputType (the KerasInput role). Under
+    channels_first the [C, H, W] input maps to our NHWC layout — callers
+    feed NHWC-transposed arrays, the reference's
+    TensorFlowCnnToFeedForwardPreProcessor dim-ordering contract."""
     dims = [d for d in shape[1:]]
     if any(d is None for d in dims):
         raise UnsupportedKerasConfigurationException(
@@ -38,13 +41,43 @@ def _input_type_from_shape(shape) -> InputType:
     if len(dims) == 1:
         return InputType.feed_forward(int(dims[0]))
     if len(dims) == 2:  # [time, features]
+        if data_format == "channels_first":
+            raise UnsupportedKerasConfigurationException(
+                "channels_first 1-D (Conv1D-style) models are not "
+                "supported; only 2-D CNN channels_first import is")
         return InputType.recurrent(int(dims[1]),
                                    timeseries_length=int(dims[0]))
-    if len(dims) == 3:  # channels_last [h, w, c]
+    if len(dims) == 3:
+        if data_format == "channels_first":  # [c, h, w] → (h, w, c)
+            return InputType.convolutional(int(dims[1]), int(dims[2]),
+                                           int(dims[0]))
         return InputType.convolutional(int(dims[0]), int(dims[1]),
                                        int(dims[2]))
     raise UnsupportedKerasConfigurationException(
         f"Unsupported input rank for shape {shape}")
+
+
+def _detect_data_format(layer_cfgs) -> str:
+    """Model-wide dim ordering: any layer declaring channels_first flips
+    the whole model (Keras models are uniformly one ordering; mixtures
+    are rejected layer-by-layer in _check_data_format)."""
+    for lc in layer_cfgs:
+        if lc.get("config", {}).get("data_format") == "channels_first":
+            return "channels_first"
+    return "channels_last"
+
+
+def _permute_flatten_dense(weights_fn, h: int, w: int, c: int):
+    """Wrap a dense weight transform so kernel ROWS reorder from Keras's
+    channels_first flatten order (c, h, w) to our NHWC flatten order
+    (h, w, c) — the TensorFlowCnnToFeedForwardPreProcessor fix."""
+    perm = np.arange(c * h * w).reshape(c, h, w).transpose(1, 2, 0).reshape(-1)
+
+    def fixed(kw):
+        out = dict(weights_fn(kw))
+        out["W"] = np.asarray(out["W"])[perm]
+        return out
+    return fixed
 
 
 def _batch_shape(layer_cfg: dict) -> Optional[list]:
@@ -124,6 +157,7 @@ class KerasModelImport:
                     "Model has no training_config (was it compiled before "
                     "saving?)")
             layer_cfgs = cfg["config"]["layers"]
+            data_format = _detect_data_format(layer_cfgs)
 
             input_type = None
             mapped_layers: List[Tuple[Mapped, str]] = []  # (mapped, keras name)
@@ -177,9 +211,10 @@ class KerasModelImport:
                     continue  # folded into the terminal loss head
                 shape = _batch_shape(lc)
                 if shape is not None and input_type is None:
-                    input_type = _input_type_from_shape(shape)
+                    input_type = _input_type_from_shape(shape, data_format)
                 m = map_layer(lc["class_name"], lc.get("config", {}),
-                              is_terminal=(i == last_param_idx), loss=loss)
+                              is_terminal=(i == last_param_idx), loss=loss,
+                              data_format=data_format)
                 if i == last_param_idx and terminal_act is not None and \
                         m.layer is not None:
                     m.layer.activation = terminal_act
@@ -209,6 +244,18 @@ class KerasModelImport:
             conf = lb.set_input_type(input_type).build()
             net = MultiLayerNetwork(conf).init()
 
+            if data_format == "channels_first":
+                # first dense after a CNN stage: Keras flattened (c,h,w),
+                # we flatten (h,w,c) — permute its kernel rows (the
+                # TensorFlowCnnToFeedForwardPreProcessor role)
+                from ..nn.conf.inputs import CnnToFeedForwardPreProcessor
+                for idx, (m, _) in enumerate(mapped_layers):
+                    p = conf.preprocessor(idx)
+                    if isinstance(p, CnnToFeedForwardPreProcessor) and \
+                            m.weights is not None:
+                        m.weights = _permute_flatten_dense(
+                            m.weights, p.height, p.width, p.channels)
+
             params = list(net.params_tree)
             states = list(net.state_tree)
             for idx, (m, kname) in enumerate(mapped_layers):
@@ -230,9 +277,18 @@ class KerasModelImport:
             if cfg.get("class_name") == "Sequential":
                 layer_cfgs, inbound, inputs, outputs = \
                     KerasModelImport._sequential_as_graph(cfg)
+                if _detect_data_format(layer_cfgs) == "channels_first":
+                    raise UnsupportedKerasConfigurationException(
+                        "channels_first import is supported on the "
+                        "sequential path only; use "
+                        "import_keras_sequential_model_and_weights")
             elif cfg.get("class_name") in ("Functional", "Model"):
                 gc = cfg["config"]
                 layer_cfgs = gc["layers"]
+                if _detect_data_format(layer_cfgs) == "channels_first":
+                    raise UnsupportedKerasConfigurationException(
+                        "channels_first functional models are not "
+                        "supported (sequential channels_first is)")
                 inbound = {lc["config"]["name"]:
                            _inbound_names(lc.get("inbound_nodes", []))
                            for lc in layer_cfgs}

@@ -60,17 +60,61 @@ class ZooModel:
             return ComputationGraph(c).init(**init_kwargs)
         return MultiLayerNetwork(c).init(**init_kwargs)
 
-    def init_pretrained(self, path: str):
-        """Load pretrained weights from a local checkpoint (reference
-        initPretrained downloads by URL+checksum, ZooModel.java:40-81; this
-        environment is zero-egress so weights come from a file)."""
-        try:
-            from ..utils.model_serializer import restore_model
-        except ImportError as e:
-            raise NotImplementedError(
-                "Checkpoint loading (utils.model_serializer) is not built "
-                "yet; coming with the ModelSerializer milestone") from e
-        return restore_model(path)
+    def pretrained_checksum(self) -> Optional[str]:
+        """Expected sha256 of the pretrained artifact, when the model
+        publishes one (reference ZooModel.pretrainedChecksum, an Adler32
+        over the download — ZooModel.java:40-81)."""
+        return None
+
+    def init_pretrained(self, path: str, verify_checksum: bool = True,
+                        expected_sha256: Optional[str] = None):
+        """Load pretrained weights from a local checkpoint artifact,
+        verifying its checksum (reference initPretrained downloads by
+        URL then checks the checksum before deserializing,
+        ZooModel.java:40-81; this environment is zero-egress so the
+        artifact comes from a file — same integrity contract)."""
+        import os
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                f"No pretrained artifact at {path!r} (this environment "
+                "cannot download; place the checkpoint there)")
+        expected = expected_sha256 or self.pretrained_checksum()
+        if verify_checksum and expected:
+            import hashlib
+            h = hashlib.sha256()
+            with open(path, "rb") as f:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    h.update(chunk)
+            got = h.hexdigest()
+            if got != expected:
+                raise ValueError(
+                    f"Pretrained artifact checksum mismatch for "
+                    f"{type(self).__name__}: got {got}, expected "
+                    f"{expected} — corrupt or wrong file (reference "
+                    "deletes and re-downloads, ZooModel.java:70-81)")
+        from ..utils.model_serializer import restore_model
+        net = restore_model(path)
+        mine = self.conf()
+        if type(net.conf) is not type(mine):
+            raise ValueError(
+                f"Artifact at {path!r} holds a "
+                f"{type(net.conf).__name__}, not this zoo model's "
+                f"{type(mine).__name__}")
+        # structural check: the artifact must BE this architecture, not
+        # merely the same container class (a VGG16 checkpoint must not
+        # satisfy LeNet.init_pretrained)
+        def sig(conf):
+            if hasattr(conf, "layers"):
+                return [type(l).__name__ for l in conf.layers]
+            return [type(n.layer).__name__ if n.is_layer()
+                    else type(n.vertex).__name__
+                    for n in conf.nodes.values()]
+        if sig(net.conf) != sig(mine):
+            raise ValueError(
+                f"Artifact at {path!r} is a different architecture "
+                f"({len(sig(net.conf))} layers) than "
+                f"{type(self).__name__} ({len(sig(mine))} layers)")
+        return net
 
 
 # --------------------------------------------------------------------------

@@ -7,6 +7,9 @@ reference's committed fixture models for KerasModelEndToEndTest.java."""
 import os
 
 os.environ["CUDA_VISIBLE_DEVICES"] = "-1"
+# oneDNN fast-math perturbs conv outputs by ~1e-2; recorded expectations
+# must be plain-f32 so import predict-equality can assert tightly
+os.environ["TF_ENABLE_ONEDNN_OPTS"] = "0"
 
 import numpy as np  # noqa: E402
 
@@ -110,6 +113,42 @@ relu_tail = keras.Sequential([
 ])
 relu_tail.compile(loss="categorical_crossentropy", optimizer="adam")
 save(relu_tail, "relu_tail", rng.standard_normal((5, 8)).astype(np.float32))
+
+# 8. channels_first (theano-dim-ordering era) sequential CNN. TF-CPU
+# cannot RUN channels_first convs, but it can build+save them; the
+# recorded predictions come from the mathematically equivalent
+# channels_last model (same conv kernels — Keras stores HWIO for both
+# orderings — and the dense kernel rows permuted from (c,h,w) to
+# (h,w,c) flatten order). The .h5 on disk is a REAL channels_first
+# model; the equivalence below is exactly what the importer must do.
+C, H, W = 2, 10, 8
+cf = keras.Sequential([
+    keras.Input((C, H, W)),
+    layers.Conv2D(4, 3, padding="same", activation="relu",
+                  data_format="channels_first", name="cfc"),
+    layers.MaxPooling2D(2, data_format="channels_first", name="cfp"),
+    layers.Flatten(data_format="channels_first", name="cff"),
+    layers.Dense(5, activation="softmax", name="cfo"),
+])
+cf.compile(loss="categorical_crossentropy", optimizer="adam")
+cf.save(os.path.join(OUT, "cnn_cf.h5"))
+
+cl = keras.Sequential([
+    keras.Input((H, W, C)),
+    layers.Conv2D(4, 3, padding="same", activation="relu", name="clc"),
+    layers.MaxPooling2D(2, name="clp"),
+    layers.Flatten(name="clf"),
+    layers.Dense(5, activation="softmax", name="clo"),
+])
+cl.get_layer("clc").set_weights(cf.get_layer("cfc").get_weights())
+ck, cb = cf.get_layer("cfo").get_weights()
+ph, pw = H // 2, W // 2
+perm = np.arange(4 * ph * pw).reshape(4, ph, pw).transpose(1, 2, 0).reshape(-1)
+cl.get_layer("clo").set_weights([ck[perm], cb])
+x_cf = rng.standard_normal((5, C, H, W)).astype(np.float32)
+y_cf = cl.predict(x_cf.transpose(0, 2, 3, 1), verbose=0)
+expected["cnn_cf_x"] = x_cf
+expected["cnn_cf_y"] = y_cf
 
 np.savez(os.path.join(OUT, "expected.npz"), **expected)
 print("Wrote fixtures to", OUT)

@@ -1,0 +1,60 @@
+"""Train + commit the zoo LeNet pretrained artifact.
+
+Run once on CPU:
+    python tests/fixtures/make_pretrained_fixture.py
+Writes tests/fixtures/pretrained/lenet_mnist.zip (a REAL trained
+checkpoint — the zero-egress stand-in for the reference's hosted
+pretrained weights, ZooModel.java:40-81) and manifest.json with its
+sha256 + the accuracy it reached on the deterministic synthetic MNIST
+test split (fetchers.synthesize_mnist_idx, seed 42)."""
+import hashlib
+import json
+import os
+import sys
+import tempfile
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(os.path.dirname(HERE)))
+
+import numpy as np  # noqa: E402
+
+from deeplearning4j_tpu.data.fetchers import MnistDataSetIterator  # noqa: E402
+from deeplearning4j_tpu.data.normalizers import ImagePreProcessingScaler  # noqa: E402
+from deeplearning4j_tpu.models import LeNet  # noqa: E402
+from deeplearning4j_tpu.utils.model_serializer import save_model  # noqa: E402
+
+OUT = os.path.join(HERE, "pretrained")
+os.makedirs(OUT, exist_ok=True)
+
+data_dir = tempfile.mkdtemp()
+train_it = MnistDataSetIterator(64, train=True, flatten=False,
+                                path=data_dir, synthesize=True)
+train_it.pre_processor = ImagePreProcessingScaler()
+net = LeNet().init()
+net.fit(train_it, epochs=3)
+
+test_it = MnistDataSetIterator(256, train=False, flatten=False,
+                               path=data_dir)
+test_it.pre_processor = ImagePreProcessingScaler()
+correct = total = 0
+for ds in test_it:
+    pred = net.predict(ds.features)
+    correct += int((pred == ds.labels.argmax(1)).sum())
+    total += len(pred)
+acc = correct / total
+print(f"synthetic-MNIST test accuracy: {acc:.3f} ({correct}/{total})")
+assert acc > 0.9, "refusing to commit an untrained artifact"
+
+path = os.path.join(OUT, "lenet_mnist.zip")
+save_model(net, path, save_updater=False)  # inference artifact: 1/3 size
+sha = hashlib.sha256(open(path, "rb").read()).hexdigest()
+with open(os.path.join(OUT, "manifest.json"), "w") as f:
+    json.dump({"file": "lenet_mnist.zip", "sha256": sha,
+               "test_accuracy": acc,
+               "dataset": "synthesize_mnist_idx(seed=42) test split"},
+              f, indent=2)
+print("sha256:", sha)

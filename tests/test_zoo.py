@@ -121,3 +121,70 @@ class TestZooCompletion:
         for zt in ZooType:
             m = model_selector(zt, num_labels=4)
             assert m.num_labels == 4
+
+
+class TestInitPretrained:
+    """init_pretrained end-to-end over the committed trained artifact
+    (VERDICT r2 item 7: checksum verification + ImageNetLabels util;
+    reference ZooModel.java:40-81)."""
+
+    @staticmethod
+    def _artifact():
+        import json
+        import os
+        d = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "fixtures", "pretrained")
+        with open(os.path.join(d, "manifest.json")) as f:
+            m = json.load(f)
+        return os.path.join(d, m["file"]), m["sha256"]
+
+    def test_init_pretrained_loads_and_predicts(self, tmp_path):
+        import tempfile
+        from deeplearning4j_tpu.data.fetchers import MnistDataSetIterator
+        from deeplearning4j_tpu.data.normalizers import \
+            ImagePreProcessingScaler
+        from deeplearning4j_tpu.models import LeNet
+        path, sha = self._artifact()
+        net = LeNet().init_pretrained(path, expected_sha256=sha)
+        # the artifact was trained on the deterministic synthetic MNIST
+        # (seed 42); the same corpus regenerates here and accuracy must
+        # carry over — proof the weights actually loaded
+        it = MnistDataSetIterator(256, train=False, flatten=False,
+                                  path=str(tmp_path), synthesize=True)
+        it.pre_processor = ImagePreProcessingScaler()
+        correct = total = 0
+        for ds in it:
+            pred = net.predict(ds.features)
+            correct += int((pred == ds.labels.argmax(1)).sum())
+            total += len(pred)
+        assert correct / total > 0.9, f"{correct}/{total}"
+
+    def test_checksum_mismatch_rejected(self):
+        from deeplearning4j_tpu.models import LeNet
+        path, _ = self._artifact()
+        with pytest.raises(ValueError, match="checksum mismatch"):
+            LeNet().init_pretrained(path, expected_sha256="0" * 64)
+
+    def test_missing_artifact_loud(self, tmp_path):
+        from deeplearning4j_tpu.models import LeNet
+        with pytest.raises(FileNotFoundError, match="cannot download"):
+            LeNet().init_pretrained(str(tmp_path / "nope.zip"))
+
+
+class TestImageNetLabels:
+    def test_labels_and_decode(self, tmp_path):
+        import json
+        from deeplearning4j_tpu.models.labels import ImageNetLabels
+        # the standard imagenet_class_index.json format
+        idx = {str(i): [f"n{i:08d}", f"class_{i}"] for i in range(5)}
+        p = tmp_path / "imagenet_class_index.json"
+        p.write_text(json.dumps(idx))
+        labels = ImageNetLabels(str(p))
+        assert len(labels) == 5
+        assert labels.get_label(3) == "class_3"
+        assert labels.wnid(2) == "n00000002"
+        probs = np.array([[0.1, 0.05, 0.6, 0.2, 0.05]])
+        top = labels.decode_predictions(probs, top=2)
+        assert top[0][0][1] == "class_2"
+        assert top[0][1][1] == "class_3"
+        assert abs(top[0][0][2] - 0.6) < 1e-6
