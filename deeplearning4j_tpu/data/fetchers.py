@@ -376,3 +376,39 @@ class LFWDataSetIterator(DataSetIterator):
             ds = DataSet(ds.features[:keep], ds.labels[:keep])
         self._served += ds.features.shape[0]
         return self._maybe_preprocess(ds)
+
+
+# ---------------------------------------------------------------------------
+# Curves (the classic deep-autoencoder dataset shape)
+# ---------------------------------------------------------------------------
+
+
+def curves_dataset(n: int = 2048, seed: int = 45) -> DataSet:
+    """The reference's CurvesDataFetcher downloads curves.ser — 28x28
+    rasterized random smooth curves, the Hinton deep-autoencoder
+    benchmark shape. Zero-egress: deterministic synthesis of the same
+    kind of data (three-control-point quadratic Bezier curves rasterized
+    to 28x28, values in [0,1]); features == labels (reconstruction
+    task), exactly how the reference serves it (CurvesDataFetcher.java)."""
+    rng = np.random.default_rng(seed)
+    size = 28
+    imgs = np.zeros((n, size, size), np.float32)
+    t = np.linspace(0.0, 1.0, 64)[:, None]
+    for i in range(n):
+        p = rng.uniform(3, size - 4, (3, 2))
+        pts = ((1 - t) ** 2 * p[0] + 2 * (1 - t) * t * p[1] + t ** 2 * p[2])
+        xi = np.clip(pts[:, 0].round().astype(int), 0, size - 1)
+        yi = np.clip(pts[:, 1].round().astype(int), 0, size - 1)
+        imgs[i, yi, xi] = 1.0
+    flat = imgs.reshape(n, size * size)
+    return DataSet(flat, flat.copy())
+
+
+class CurvesDataSetIterator(ListDataSetIterator):
+    """Reference datasets/fetchers/CurvesDataFetcher.java served through
+    the iterator SPI (features == labels, autoencoder-style)."""
+
+    def __init__(self, batch_size: int = 128, num_examples: int = 2048,
+                 seed: int = 45):
+        super().__init__(curves_dataset(num_examples, seed),
+                         batch_size=batch_size)

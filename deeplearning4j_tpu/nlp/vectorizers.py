@@ -165,3 +165,64 @@ class CnnSentenceDataSetIterator(DataSetIterator):
                 mask[b, 0] = 1.0  # keep the row alive (all-OOV sentence)
             y[b, self._label_idx[label]] = 1.0
         return DataSet(x, y, mask, None)
+
+
+class Word2VecDataSetIterator(DataSetIterator):
+    """Labelled sentences → RNN DataSets where every timestep is a word
+    vector and the sentence label broadcasts over valid timesteps
+    (reference iterator/Word2VecDataSetIterator.java: Word2Vec +
+    LabelAwareSentenceIterator glue feeding recurrent nets; labels are
+    set at each timestep with the mask marking real tokens)."""
+
+    def __init__(self, word_vectors: WordVectors,
+                 sentences: Sequence[Tuple[str, str]],
+                 labels: Sequence[str], batch_size: int = 32,
+                 max_length: Optional[int] = None,
+                 tokenizer_factory: Optional[TokenizerFactory] = None):
+        self.wv = word_vectors
+        self.labels = list(labels)
+        self._label_idx = {l: i for i, l in enumerate(self.labels)}
+        self._batch = int(batch_size)
+        self.tf = tokenizer_factory or DefaultTokenizerFactory()
+        self.embed = word_vectors.get_word_vector_matrix().shape[1]
+        # tokenize ONCE: the init pass needs the lengths for max_length
+        # anyway, and every epoch reuses the token lists
+        self.data = [(self.tf.create(t).get_tokens(), lab)
+                     for t, lab in sentences]
+        if max_length is None:
+            max_length = max((len(t) for t, _ in self.data), default=1)
+        self.max_length = int(max_length)
+        self._pos = 0
+
+    def reset(self):
+        self._pos = 0
+
+    def batch_size(self):
+        return self._batch
+
+    def total_examples(self):
+        return len(self.data)
+
+    def __next__(self) -> DataSet:
+        if self._pos >= len(self.data):
+            raise StopIteration
+        chunk = self.data[self._pos:self._pos + self._batch]
+        self._pos += len(chunk)
+        B, T, E = len(chunk), self.max_length, self.embed
+        L = len(self.labels)
+        x = np.zeros((B, T, E), np.float32)
+        y = np.zeros((B, T, L), np.float32)
+        mask = np.zeros((B, T), np.float32)
+        for b, (tokens, label) in enumerate(chunk):
+            vecs = [v for v in (self.wv.word_vector(tok)
+                                for tok in tokens)
+                    if v is not None][:T]
+            li = self._label_idx[label]
+            for t_out, v in enumerate(vecs):
+                x[b, t_out] = v
+                y[b, t_out, li] = 1.0
+                mask[b, t_out] = 1.0
+            if not vecs:
+                mask[b, 0] = 1.0
+                y[b, 0, li] = 1.0
+        return DataSet(x, y, mask, mask.copy())

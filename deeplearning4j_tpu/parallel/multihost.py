@@ -300,6 +300,49 @@ class MultiHostRunner:
         wrapper.finalize()
         return wrapper
 
+    # --------------------------------------------------------- repartitioning
+    @staticmethod
+    def balanced_partition(n: int, num_partitions: int, partition: int
+                           ) -> slice:
+        """Row slice for `partition` under balanced partitioning
+        (reference impl/common/repartition/BalancedPartitioner.java:
+        each partition gets floor(n/P) elements, the first n%P get one
+        more). Use to FIX unbalanced local data instead of being
+        rejected by the lockstep guards."""
+        if not 0 <= partition < num_partitions:
+            raise ValueError(f"partition {partition} not in "
+                             f"[0, {num_partitions})")
+        base, extra = divmod(n, num_partitions)
+        start = partition * base + min(partition, extra)
+        return slice(start, start + base + (1 if partition < extra else 0))
+
+    def my_partition(self, *arrays, drop_remainder: bool = True):
+        """Balanced-repartition helper bound to THIS process: slice each
+        array to this process's share of the global rows. With
+        drop_remainder (default) every process gets EXACTLY floor(n/P)
+        rows, which is what the SPMD lockstep contract requires — the
+        dropped tail (< P rows) is logged."""
+        P = jax.process_count()
+        p = jax.process_index()
+        out = []
+        for a in arrays:
+            a = np.asarray(a)
+            n = a.shape[0]
+            if n < P:
+                raise ValueError(
+                    f"cannot partition {n} rows over {P} processes — "
+                    "every process would train on (almost) nothing")
+            if drop_remainder:
+                per = n // P
+                if per * P != n:
+                    log.info("my_partition: dropping %d tail rows "
+                             "(%d rows over %d processes)",
+                             n - per * P, n, P)
+                out.append(a[p * per:(p + 1) * per])
+            else:
+                out.append(a[self.balanced_partition(n, P, p)])
+        return out[0] if len(out) == 1 else tuple(out)
+
     # ------------------------------------------------------------ checkpoint
     def save_checkpoint(self, model, path: str):
         """Chief-only write + cluster barrier (reference: only the Spark

@@ -219,3 +219,35 @@ class TestAsyncMulti:
                         jax.tree_util.tree_leaves(g_sync.params_tree)):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
         assert g_async.iteration == 9
+
+
+class TestCurves:
+    def test_curves_shapes_and_determinism(self):
+        from deeplearning4j_tpu.data.fetchers import (CurvesDataSetIterator,
+                                                      curves_dataset)
+        ds = curves_dataset(64, seed=45)
+        assert ds.features.shape == (64, 784)
+        np.testing.assert_array_equal(ds.features, ds.labels)
+        ds2 = curves_dataset(64, seed=45)
+        np.testing.assert_array_equal(ds.features, ds2.features)
+        it = CurvesDataSetIterator(16, num_examples=32)
+        assert sum(b.features.shape[0] for b in it) == 32
+
+    def test_curves_autoencoder_learns(self):
+        from deeplearning4j_tpu import (Adam, DenseLayer, InputType,
+                                        MultiLayerNetwork,
+                                        NeuralNetConfiguration, OutputLayer)
+        from deeplearning4j_tpu.data.fetchers import CurvesDataSetIterator
+        it = CurvesDataSetIterator(64, num_examples=256)
+        conf = (NeuralNetConfiguration.builder().seed(3).updater(Adam(1e-3))
+                .list()
+                .layer(DenseLayer(n_out=64, activation="relu"))
+                .layer(OutputLayer(n_out=784, activation="sigmoid",
+                                   loss="xent"))
+                .set_input_type(InputType.feed_forward(784))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit(it, epochs=2)
+        first = float(net.score_value)
+        net.fit(it, epochs=10)
+        assert float(net.score_value) < first

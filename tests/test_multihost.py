@@ -180,3 +180,27 @@ class TestKillAndResume:
         assert abs(resumed[1] - ref[0]) < 1e-4
 
         shutil.rmtree(clean_dir, ignore_errors=True)
+
+
+class TestBalancedPartition:
+    """Reference impl/common/repartition/BalancedPartitioner.java role:
+    FIX unbalanced local data instead of rejecting it."""
+
+    def test_balanced_slices_cover_and_balance(self):
+        from deeplearning4j_tpu.parallel.multihost import MultiHostRunner
+        n, P = 23, 4
+        sizes = []
+        covered = []
+        for p in range(P):
+            s = MultiHostRunner.balanced_partition(n, P, p)
+            sizes.append(s.stop - s.start)
+            covered.extend(range(s.start, s.stop))
+        assert sorted(covered) == list(range(n))
+        assert max(sizes) - min(sizes) <= 1  # the balance contract
+        assert sizes == [6, 6, 6, 5]
+
+    def test_bad_partition_rejected(self):
+        import pytest as _pytest
+        from deeplearning4j_tpu.parallel.multihost import MultiHostRunner
+        with _pytest.raises(ValueError):
+            MultiHostRunner.balanced_partition(10, 4, 4)

@@ -398,3 +398,63 @@ class TestNewPreprocessors:
         x = rng.normal(0, [1.0, 5.0, 0.2], (200, 3)).astype(np.float32)
         out = UnitVarianceProcessor()(x)
         np.testing.assert_allclose(np.asarray(out).std(0), 1.0, atol=1e-2)
+
+
+class TestWord2VecDataSetIterator:
+    """Round-3 parity: reference iterator/Word2VecDataSetIterator.java
+    (Word2Vec + labelled sentences → RNN training tensors)."""
+
+    def _wv(self):
+        from deeplearning4j_tpu.nlp.vocab import VocabCache
+        from deeplearning4j_tpu.nlp.word2vec import WordVectors
+        cache = VocabCache()
+        for w in ["good", "bad", "great", "awful", "movie"]:
+            cache.add_token(w, count=2)
+        cache.finish(min_word_frequency=1)
+        rng = np.random.default_rng(0)
+        return WordVectors(cache, rng.standard_normal(
+            (len(cache), 6)).astype(np.float32))
+
+    def test_shapes_labels_masks(self):
+        from deeplearning4j_tpu.nlp.vectorizers import Word2VecDataSetIterator
+        wv = self._wv()
+        data = [("good great movie", "pos"), ("bad awful", "neg"),
+                ("zzz unknown", "neg")]
+        it = Word2VecDataSetIterator(wv, data, ["pos", "neg"],
+                                     batch_size=2)
+        ds1 = next(iter(it))
+        assert ds1.features.shape == (2, 3, 6)
+        assert ds1.labels.shape == (2, 3, 2)
+        # label broadcasts over valid timesteps only
+        np.testing.assert_array_equal(ds1.labels[0, :, 0], [1, 1, 1])
+        np.testing.assert_array_equal(ds1.features_mask[1], [1, 1, 0])
+        np.testing.assert_array_equal(ds1.labels[1, :, 1], [1, 1, 0])
+        # word vectors actually looked up
+        np.testing.assert_allclose(
+            ds1.features[0, 0], wv.word_vector("good"))
+        ds2 = next(it)
+        # all-OOV row stays alive with one masked timestep
+        np.testing.assert_array_equal(ds2.features_mask[0], [1, 0, 0])
+        assert ds2.labels[0, 0, 1] == 1.0
+
+    def test_trains_an_rnn(self):
+        from deeplearning4j_tpu import (Adam, GravesLSTM, InputType,
+                                        MultiLayerNetwork,
+                                        NeuralNetConfiguration,
+                                        RnnOutputLayer)
+        from deeplearning4j_tpu.nlp.vectorizers import Word2VecDataSetIterator
+        wv = self._wv()
+        data = [("good great movie", "pos"), ("great good", "pos"),
+                ("bad awful movie", "neg"), ("awful bad", "neg")] * 4
+        it = Word2VecDataSetIterator(wv, data, ["pos", "neg"],
+                                     batch_size=8)
+        conf = (NeuralNetConfiguration.builder().seed(5).updater(Adam(0.02))
+                .list()
+                .layer(GravesLSTM(n_out=8, activation="tanh"))
+                .layer(RnnOutputLayer(n_out=2, activation="softmax",
+                                      loss="mcxent"))
+                .set_input_type(InputType.recurrent(6))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit(it, epochs=30)
+        assert float(net.score_value) < 0.4
