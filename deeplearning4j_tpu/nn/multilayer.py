@@ -216,6 +216,50 @@ class MultiLayerNetwork(DeviceIterationMixin):
         self._multi_step_repeat_fn = jax.jit(
             multi_step_repeat, donate_argnums=(0, 1, 2),
             static_argnums=(9,))
+
+        def multi_step_repeat_tbptt(params, opt_state, state, iteration,
+                                    rng, x, y, fmask, lmask, length):
+            # One dispatch for `length` full tBPTT batch passes: each
+            # scan step seeds a fresh recurrent carry, unrolls the
+            # window schedule (static from the traced shapes), and
+            # strips the carry — exactly the fit_batch/_fit_tbptt
+            # semantics, minus the per-window dispatch latency.
+            T = x.shape[1]
+            L = self.conf.tbptt_fwd_length
+            batch = x.shape[0]
+
+            def seed_merge(st_tuple):
+                return tuple(
+                    {**st, **(layer.seed_recurrent_state(batch,
+                                                         self._dtype)
+                              if layer.is_recurrent() else {})}
+                    for layer, st in zip(layers, st_tuple))
+
+            def strip(st_tuple):
+                return tuple({k: v for k, v in st.items()
+                              if k not in ("h", "c")} for st in st_tuple)
+
+            def body(carry, _):
+                p, o, s, it, r = carry
+                ms = seed_merge(s)
+                loss = jnp.asarray(0.0, jnp.float32)
+                for start in range(0, T, L):
+                    end = min(start + L, T)
+                    fm = None if fmask is None else fmask[:, start:end]
+                    lm = None if lmask is None else lmask[:, start:end]
+                    p, o, ms, it, r, loss = train_step(
+                        p, o, ms, it, r, x[:, start:end],
+                        y[:, start:end], fm, lm)
+                return (p, o, strip(ms), it, r), loss
+
+            carry, losses = jax.lax.scan(
+                body, (params, opt_state, state, iteration, rng), None,
+                length=length)
+            return (*carry, losses)
+
+        self._multi_step_repeat_tbptt_fn = jax.jit(
+            multi_step_repeat_tbptt, donate_argnums=(0, 1, 2),
+            static_argnums=(9,))
         self._output_fn = jax.jit(
             lambda params, state, x, fmask:
             self._forward_pure(params, state, x, False, None, fmask)[0])
@@ -288,38 +332,54 @@ class MultiLayerNetwork(DeviceIterationMixin):
 
     def fit_batch_repeated(self, ds: DataSet, steps: int
                            ) -> "MultiLayerNetwork":
-        """`steps` optimizer steps on one device-resident minibatch in
-        one dispatch (lax.scan with the batch closed over — not
-        replicated in HBM)."""
+        """`steps` repeats of one device-resident minibatch in one
+        dispatch (lax.scan with the batch closed over — not replicated
+        in HBM). For truncated-BPTT batches each repeat runs the full
+        window schedule with a fresh recurrent carry (one optimizer step
+        PER WINDOW, so model.iteration advances steps*ceil(T/L))."""
         self._check_init()
-        if self.conf.backprop_type == BackpropType.TRUNCATED_BPTT:
-            raise NotImplementedError(
-                "fit_batch_repeated does not support truncated BPTT")
         self._rnn_carry = None
+        args = (self._cast_features(ds.features), jnp.asarray(ds.labels),
+                None if ds.features_mask is None
+                else jnp.asarray(ds.features_mask),
+                None if ds.labels_mask is None
+                else jnp.asarray(ds.labels_mask))
+        if self.conf.backprop_type == BackpropType.TRUNCATED_BPTT and \
+                np.asarray(ds.features).ndim == 3 and \
+                np.asarray(ds.labels).ndim == 3:
+            T = np.asarray(ds.features).shape[1]
+            windows = -(-T // self.conf.tbptt_fwd_length)
+            out = self._multi_step_repeat_tbptt_fn(
+                self.params_tree, self.opt_state, self.state_tree,
+                self._iteration_device(None), self._rng, *args,
+                int(steps))
+            self._commit_multi(out, int(steps) * windows,
+                               listener_events=int(steps))
+            return self
         out = self._multi_step_repeat_fn(
             self.params_tree, self.opt_state, self.state_tree,
-            self._iteration_device(None), self._rng,
-            self._cast_features(ds.features), jnp.asarray(ds.labels),
-            None if ds.features_mask is None
-            else jnp.asarray(ds.features_mask),
-            None if ds.labels_mask is None
-            else jnp.asarray(ds.labels_mask), int(steps))
+            self._iteration_device(None), self._rng, *args, int(steps))
         self._commit_multi(out, int(steps))
         return self
 
-    def _commit_multi(self, out, steps: int):
+    def _commit_multi(self, out, steps: int, listener_events=None):
+        """`steps` = optimizer iterations taken; `listener_events` = how
+        many per-scan losses exist (tBPTT repeats record one loss per
+        REPEAT while taking several window steps)."""
         (self.params_tree, self.opt_state, self.state_tree, it, self._rng,
          losses) = out
+        events = steps if listener_events is None else listener_events
         self._iteration += steps
         self._iteration_dev = it
         self._iteration_dev_mesh = None
         self.score_value = losses[-1]
         if self.listeners:
-            for k in range(steps):
+            per = steps // max(events, 1)
+            for k in range(events):
                 self.score_value = losses[k]
                 for lst in self.listeners:
                     lst.iteration_done(
-                        self, self._iteration - steps + k + 1)
+                        self, self._iteration - steps + (k + 1) * per)
             self.score_value = losses[-1]
 
     def fit_solver(self, x, y, *, max_iterations: int = 100,

@@ -261,3 +261,54 @@ class TestFusedMultiStepMLN:
         for a, b in zip(jax.tree_util.tree_leaves(n1.params_tree),
                         jax.tree_util.tree_leaves(n2.params_tree)):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestFusedTbpttRepeat:
+    """fit_batch_repeated over a truncated-BPTT batch must be
+    bit-identical to the per-window _fit_batch loop (one dispatch per N
+    full batch passes; the lstm bench path)."""
+
+    def _make(self):
+        from deeplearning4j_tpu import GravesLSTM, RnnOutputLayer, Sgd
+        from deeplearning4j_tpu.nn.conf.builders import BackpropType
+        conf = (NeuralNetConfiguration.builder().seed(11).updater(Sgd(0.1))
+                .list()
+                .layer(GravesLSTM(n_out=10, activation="tanh"))
+                .layer(RnnOutputLayer(n_out=6, activation="softmax",
+                                      loss="mcxent"))
+                .set_input_type(InputType.recurrent(6))
+                .backprop_type(BackpropType.TRUNCATED_BPTT)
+                .tbptt_fwd_length(5).tbptt_back_length(5)
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    def test_matches_window_loop(self):
+        rng = np.random.default_rng(0)
+        idx = rng.integers(0, 6, (8, 12))
+        ds = DataSet(np.eye(6, dtype=np.float32)[idx],
+                     np.eye(6, dtype=np.float32)[np.roll(idx, -1, 1)])
+        n1, n2 = self._make(), self._make()
+        for _ in range(3):
+            n1._fit_batch(ds)
+        n2.fit_batch_repeated(ds, 3)
+        # 3 repeats x ceil(12/5)=3 windows = 9 optimizer steps
+        assert n1.iteration == n2.iteration == 9
+        for a, b in zip(jax.tree_util.tree_leaves(n1.params_tree),
+                        jax.tree_util.tree_leaves(n2.params_tree)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_listener_iterations_align(self):
+        rng = np.random.default_rng(1)
+        idx = rng.integers(0, 6, (4, 10))
+        ds = DataSet(np.eye(6, dtype=np.float32)[idx],
+                     np.eye(6, dtype=np.float32)[np.roll(idx, -1, 1)])
+        net = self._make()
+        seen = []
+
+        class Rec:
+            def iteration_done(self, model, it):
+                seen.append(it)
+        net.listeners.append(Rec())
+        net.fit_batch_repeated(ds, 2)  # 2 repeats x 2 windows
+        assert net.iteration == 4
+        assert seen == [2, 4]  # one event per repeat, at its last window
