@@ -63,7 +63,7 @@ def build_lenet(height=28, width=28, channels=1, num_classes=10, seed=42):
     return conf
 
 
-def bench_lenet(batch=2048, steps=50, warmup=10, repeats=3):
+def bench_lenet(batch=2048, steps=50, repeats=3):
     import jax
     from deeplearning4j_tpu import MultiLayerNetwork
     from deeplearning4j_tpu.data.dataset import DataSet
@@ -127,7 +127,7 @@ def bench_resnet50(batch=1024, steps=10, repeats=3):
     return (batch * steps) / dt
 
 
-def bench_lstm(batch=128, seq_len=64, steps=30, warmup=5, repeats=3):
+def bench_lstm(batch=128, seq_len=64, steps=30, repeats=3):
     """GravesLSTM char-RNN tokens/sec (zoo TextGenerationLSTM workload;
     reference zoo/model/TextGenerationLSTM.java)."""
     import jax

@@ -28,6 +28,7 @@ from ..graph.vertices import LastTimeStepVertex
 from ..multilayer import _regularization_score
 from ..updaters import normalize_layer_gradients
 from ..stepping import DeviceIterationMixin
+from ..layers.recurrent import RECURRENT_CARRY_KEYS
 
 Array = jax.Array
 
@@ -469,9 +470,9 @@ class ComputationGraph(DeviceIterationMixin):
             return
         base, carry = {}, {}
         for name, st in new_state.items():
-            carry[name] = {k: v for k, v in st.items() if k in ("h", "c")}
+            carry[name] = {k: v for k, v in st.items() if k in RECURRENT_CARRY_KEYS}
             base[name] = {k: v for k, v in st.items()
-                          if k not in ("h", "c")}
+                          if k not in RECURRENT_CARRY_KEYS}
         self.state_tree = base
         self._rnn_carry = {k: v for k, v in carry.items() if v}
 

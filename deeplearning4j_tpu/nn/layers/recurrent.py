@@ -51,6 +51,13 @@ from .core import BIAS, WEIGHT, BaseOutputLayer, Layer, dropout
 
 Array = jax.Array
 
+# The recurrent streaming-carry state keys (h = hidden, c = cell).
+# Every site that merges/strips the carry — MLN/CG _commit_state, the
+# fused tBPTT scan, ParallelWrapper's replica averaging — must use THIS
+# set so a future carry key cannot silently leak on one path.
+RECURRENT_CARRY_KEYS = ("h", "c")
+
+
 RECURRENT_WEIGHT = "RW"
 # Peephole weights (GravesLSTM); reference packs them as RW columns 4H..4H+3.
 PEEP_F = "wF"

@@ -39,6 +39,7 @@ from .conf.builders import BackpropType, MultiLayerConfiguration
 from .layers import core as core_layers
 from .updaters import normalize_layer_gradients
 from .stepping import DeviceIterationMixin
+from .layers.recurrent import RECURRENT_CARRY_KEYS
 
 Array = jax.Array
 
@@ -237,7 +238,7 @@ class MultiLayerNetwork(DeviceIterationMixin):
 
             def strip(st_tuple):
                 return tuple({k: v for k, v in st.items()
-                              if k not in ("h", "c")} for st in st_tuple)
+                              if k not in RECURRENT_CARRY_KEYS} for st in st_tuple)
 
             def body(carry, _):
                 p, o, s, it, r = carry
@@ -344,18 +345,28 @@ class MultiLayerNetwork(DeviceIterationMixin):
                 else jnp.asarray(ds.features_mask),
                 None if ds.labels_mask is None
                 else jnp.asarray(ds.labels_mask))
+        # shape metadata only — np.asarray here would d2h-copy a
+        # device-resident batch inside benchmarks' timed regions
         if self.conf.backprop_type == BackpropType.TRUNCATED_BPTT and \
-                np.asarray(ds.features).ndim == 3 and \
-                np.asarray(ds.labels).ndim == 3:
-            T = np.asarray(ds.features).shape[1]
-            windows = -(-T // self.conf.tbptt_fwd_length)
-            out = self._multi_step_repeat_tbptt_fn(
-                self.params_tree, self.opt_state, self.state_tree,
-                self._iteration_device(None), self._rng, *args,
-                int(steps))
-            self._commit_multi(out, int(steps) * windows,
-                               listener_events=int(steps))
-            return self
+                args[0].ndim == 3:
+            if args[1].ndim != 3:
+                # mirror _fit_batch's rank-2-labels fallback, loudly
+                if not getattr(self, "_warned_tbptt_labels", False):
+                    log.warning(
+                        "Truncated BPTT requires rank-3 (time-series) "
+                        "labels; got rank-%d — using standard BPTT",
+                        args[1].ndim)
+                    self._warned_tbptt_labels = True
+            else:
+                T = args[0].shape[1]
+                windows = -(-T // self.conf.tbptt_fwd_length)
+                out = self._multi_step_repeat_tbptt_fn(
+                    self.params_tree, self.opt_state, self.state_tree,
+                    self._iteration_device(None), self._rng, *args,
+                    int(steps))
+                self._commit_multi(out, int(steps) * windows,
+                                   listener_events=int(steps))
+                return self
         out = self._multi_step_repeat_fn(
             self.params_tree, self.opt_state, self.state_tree,
             self._iteration_device(None), self._rng, *args, int(steps))
@@ -535,8 +546,8 @@ class MultiLayerNetwork(DeviceIterationMixin):
             return
         base, carry = [], []
         for st in new_state:
-            carry.append({k: v for k, v in st.items() if k in ("h", "c")})
-            base.append({k: v for k, v in st.items() if k not in ("h", "c")})
+            carry.append({k: v for k, v in st.items() if k in RECURRENT_CARRY_KEYS})
+            base.append({k: v for k, v in st.items() if k not in RECURRENT_CARRY_KEYS})
         self.state_tree = tuple(base)
         self._rnn_carry = tuple(carry)
 

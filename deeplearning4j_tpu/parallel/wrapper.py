@@ -35,6 +35,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from . import mesh as mesh_lib
+from ..nn.layers.recurrent import RECURRENT_CARRY_KEYS
 
 log = logging.getLogger(__name__)
 
@@ -291,13 +292,13 @@ class ParallelWrapper:
             # and must never be averaged across replicas
             params, opt, state = t
             state = tuple(
-                {k: (v if k in ("h", "c") else avg_one(v))
+                {k: (v if k in RECURRENT_CARRY_KEYS else avg_one(v))
                  for k, v in st.items()} for st in state)
             return tmap(avg_one, params), tmap(avg_one, opt), state
 
         def strip_carry(state):
             return tuple({k: v for k, v in st.items()
-                          if k not in ("h", "c")} for st in state)
+                          if k not in RECURRENT_CARRY_KEYS} for st in state)
 
         def take0(t):  # replicas are equal post-average; unstack view
             return tmap(lambda a: a[0], t)
