@@ -231,3 +231,150 @@ class ParameterServerTrainer:
         if self.losses:
             self.net.score_value = jnp.asarray(self.losses[-1])
         return self
+
+
+# ---------------------------------------------------------------------------
+# Cross-process transport (the dl4j-spark-parameterserver role)
+# ---------------------------------------------------------------------------
+
+
+class ParameterServerHttpNode:
+    """HTTP front for a ParameterServer so workers in OTHER processes /
+    hosts push and pull — the reference's Aeron-UDP ParameterServerNode
+    plus dl4j-spark-parameterserver's ParameterServerTrainingHook/
+    Subscriber role (gradient push + param pull from cluster workers),
+    with stdlib HTTP as the wire (the media-driver analog).
+
+    Routes:  GET  /params -> {"version": v, "blob": b64-npz(params)}
+             POST /push {"version": v, "blob": b64-npz(grads)}
+                        -> {"applied": bool, "version": v'}
+             GET  /stats -> {"version", "applied", "stale_drops"}
+    """
+
+    def __init__(self, server: ParameterServer, port: int = 0):
+        import base64
+
+        from ..utils.http_server import JsonHttpServer
+        from ..utils.model_serializer import (_npz_bytes_to_tree,
+                                              _tree_to_npz_bytes)
+        self.server = server
+        self._b64 = base64
+        self._to_npz = _tree_to_npz_bytes
+        self._from_npz = _npz_bytes_to_tree
+
+        def get_params(_):
+            version, params = server.pull()
+            blob = self._b64.b64encode(self._to_npz(params)).decode()
+            return 200, {"version": version, "blob": blob}
+
+        def post_push(payload):
+            grads = self._from_npz(
+                self._b64.b64decode(payload["blob"]), server.params)
+            applied = server.push(int(payload["version"]), grads)
+            return 200, {"applied": bool(applied),
+                         "version": server.version}
+
+        def get_stats(_):
+            return 200, {"version": server.version,
+                         "applied": server.applied,
+                         "stale_drops": server.stale_drops}
+
+        self._http = JsonHttpServer(
+            get_routes={"/params": get_params, "/stats": get_stats},
+            post_routes={"/push": post_push}, port=port)
+
+    def start(self) -> "ParameterServerHttpNode":
+        self._http.start()
+        return self
+
+    def stop(self):
+        self._http.stop()
+
+    @property
+    def url(self) -> str:
+        return self._http.url
+
+
+class HttpParameterServerClient:
+    """Worker-side pull/push over HTTP (reference ParameterServerClient).
+    `template` is a matching params pytree used to decode the wire blobs
+    (workers always hold the model, so it is free)."""
+
+    def __init__(self, url: str, template):
+        import base64
+
+        from ..utils.model_serializer import (_npz_bytes_to_tree,
+                                              _tree_to_npz_bytes)
+        self.url = url.rstrip("/")
+        self._template = template
+        self._b64 = base64
+        self._to_npz = _tree_to_npz_bytes
+        self._from_npz = _npz_bytes_to_tree
+
+    def _get(self, path):
+        import json as _json
+        import urllib.request
+        with urllib.request.urlopen(self.url + path, timeout=60) as r:
+            return _json.loads(r.read())
+
+    def pull(self):
+        rec = self._get("/params")
+        params = self._from_npz(self._b64.b64decode(rec["blob"]),
+                                self._template)
+        return int(rec["version"]), params
+
+    def push(self, version: int, grads) -> bool:
+        import json as _json
+        import urllib.request
+        body = _json.dumps({
+            "version": int(version),
+            "blob": self._b64.b64encode(self._to_npz(grads)).decode(),
+        }).encode()
+        req = urllib.request.Request(
+            self.url + "/push", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return bool(_json.loads(r.read())["applied"])
+
+    def stats(self) -> dict:
+        return self._get("/stats")
+
+
+def remote_worker_fit(net: MultiLayerNetwork, url: str, data,
+                      labels=None, *, epochs: int = 1,
+                      batch_size: int = 32, seed: int = 0) -> int:
+    """One remote worker's training loop against an HTTP parameter
+    server: pull -> local gradient -> push, retrying dropped (stale)
+    pushes on fresh params (the ParameterServerTrainingHook loop a Spark
+    executor runs). Returns the number of applied pushes."""
+    net._check_init()
+    if any(len(st) for st in net.state_tree):
+        raise NotImplementedError(
+            "async parameter-server training does not support stateful "
+            "layers")
+    client = HttpParameterServerClient(url, net.params_tree)
+    rng = jax.random.PRNGKey(seed)
+
+    def loss_and_grads(params, state, rng_, x, y, fmask, lmask):
+        (loss, _), grads = jax.value_and_grad(
+            net._loss_pure, has_aux=True)(
+                params, state, x, y, fmask, lmask, rng_, True)
+        return loss, grads
+
+    grad_fn = jax.jit(loss_and_grads)
+    it = as_iterator(data, labels, batch_size)
+    applied = 0
+    for _ in range(epochs):
+        it.reset()
+        for ds in it:
+            x = jnp.asarray(ds.features)
+            y = jnp.asarray(ds.labels)
+            while True:
+                version, params = client.pull()
+                rng, sub = jax.random.split(rng)
+                _, grads = grad_fn(params, net.state_tree, sub, x, y,
+                                   None, None)
+                if client.push(version, jax.device_get(grads)):
+                    applied += 1
+                    break
+    return applied

@@ -1,0 +1,46 @@
+"""Worker process for the HTTP parameter-server test.
+
+    python ps_http_worker.py <url> <worker_id>
+
+Builds the same-seed model, trains its data shard against the remote
+parameter server over HTTP (the dl4j-spark-parameterserver executor
+role), and prints the number of applied pushes."""
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+url, wid = sys.argv[1], int(sys.argv[2])
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from deeplearning4j_tpu import (Adam, DataSet, DenseLayer, InputType,  # noqa: E402
+                                MultiLayerNetwork, NeuralNetConfiguration,
+                                OutputLayer)
+from deeplearning4j_tpu.parallel.param_server import remote_worker_fit  # noqa: E402
+
+conf = (NeuralNetConfiguration.builder().seed(7).updater(Adam(0.05))
+        .list()
+        .layer(DenseLayer(n_out=16, activation="relu"))
+        .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.feed_forward(2))
+        .build())
+net = MultiLayerNetwork(conf).init()
+
+rng = np.random.default_rng(0)
+means = np.array([[2.0, 0.0], [-2.0, 1.5], [0.0, -2.5]], np.float32)
+x = np.concatenate([rng.normal(means[k], 0.6, (128, 2))
+                    for k in range(3)]).astype(np.float32)
+y = np.eye(3, dtype=np.float32)[np.repeat(np.arange(3), 128)]
+order = rng.permutation(len(x))
+x, y = x[order], y[order]
+half = len(x) // 2
+xs = x[wid * half:(wid + 1) * half]
+ys = y[wid * half:(wid + 1) * half]
+
+applied = remote_worker_fit(net, url, DataSet(xs, ys), epochs=8,
+                            batch_size=64, seed=100 + wid)
+print(f"APPLIED {wid} {applied}", flush=True)

@@ -116,3 +116,73 @@ def test_stateful_layers_rejected():
     net = MultiLayerNetwork(conf).init()
     with pytest.raises(NotImplementedError, match="stateful"):
         ParameterServerTrainer(net)
+
+
+class TestHttpParameterServer:
+    """Cross-process transport (the dl4j-spark-parameterserver role):
+    two OS-process workers push gradients / pull params over HTTP."""
+
+    def test_two_process_workers_converge(self):
+        import os
+        import re
+        import subprocess
+        import sys
+        from deeplearning4j_tpu.parallel.param_server import (
+            ParameterServerHttpNode)
+
+        net = _net(lr=0.05)
+        server = ParameterServer(net, max_staleness=4)
+        node = ParameterServerHttpNode(server).start()
+        try:
+            here = os.path.dirname(os.path.abspath(__file__))
+            env = {k: v for k, v in os.environ.items()
+                   if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+            procs = [subprocess.Popen(
+                [sys.executable, os.path.join(here, "ps_http_worker.py"),
+                 node.url, str(w)],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=env) for w in range(2)]
+            outs = []
+            for p in procs:
+                out, _ = p.communicate(timeout=600)
+                outs.append(out)
+                assert p.returncode == 0, f"worker failed:\n{out}"
+            counts = {}
+            for out in outs:
+                for m in re.finditer(r"^APPLIED (\d+) (\d+)$", out, re.M):
+                    counts[int(m.group(1))] = int(m.group(2))
+            assert set(counts) == {0, 1}, outs
+            # both workers genuinely contributed and the server applied
+            # every push it accepted
+            assert min(counts.values()) > 0
+            assert server.applied == sum(counts.values())
+            assert server.version == server.applied
+        finally:
+            node.stop()
+        # commit the server's params into the net and check learning
+        net.params_tree = server.params
+        x, y = _blobs(n=384, seed=9)
+        assert _accuracy(net, x, y) > 0.9
+
+    def test_http_client_roundtrip_and_staleness(self):
+        import jax
+        from deeplearning4j_tpu.parallel.param_server import (
+            HttpParameterServerClient, ParameterServerHttpNode)
+        net = _net()
+        server = ParameterServer(net, max_staleness=0)
+        node = ParameterServerHttpNode(server).start()
+        try:
+            client = HttpParameterServerClient(node.url, net.params_tree)
+            v0, params = client.pull()
+            assert v0 == 0
+            for a, b in zip(jax.tree_util.tree_leaves(params),
+                            jax.tree_util.tree_leaves(net.params_tree)):
+                np.testing.assert_array_equal(np.asarray(a),
+                                              np.asarray(b))
+            zero = jax.tree_util.tree_map(np.zeros_like, net.params_tree)
+            assert client.push(0, zero)
+            assert not client.push(0, zero)  # stale at max_staleness=0
+            s = client.stats()
+            assert s["version"] == 1 and s["stale_drops"] == 1
+        finally:
+            node.stop()
