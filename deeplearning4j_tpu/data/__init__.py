@@ -1,0 +1,17 @@
+"""Data pipeline: DataSets, iterators, readers, fetchers, normalizers
+(reference deeplearning4j-core datasets/* + DataVec glue, SURVEY.md §2.2).
+"""
+from .dataset import DataSet, MultiDataSet
+from .export import ExportedDataSetIterator, export_datasets
+from .fetchers import (CifarDataSetIterator, CurvesDataSetIterator,
+                       IrisDataSetIterator, LFWDataSetIterator,
+                       MnistDataSetIterator)
+from .images import ImageRecordReader, ImageRecordReaderDataSetIterator
+from .iterators import (AsyncDataSetIterator, AsyncMultiDataSetIterator,
+                        DataSetIterator, ExistingDataSetIterator,
+                        ListDataSetIterator)
+from .normalizers import (ImagePreProcessingScaler, NormalizerMinMaxScaler,
+                          NormalizerStandardize)
+from .records import (CSVRecordReader, CSVSequenceRecordReader,
+                      RecordReaderDataSetIterator,
+                      SequenceRecordReaderDataSetIterator)

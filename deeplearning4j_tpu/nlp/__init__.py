@@ -7,3 +7,5 @@ from .serializer import WordVectorSerializer
 from .vectorizers import (ENGLISH_STOP_WORDS, BagOfWordsVectorizer,
                           CnnSentenceDataSetIterator, TfidfVectorizer)
 from .word2vec import Word2Vec, WordVectors
+from .distributed import ShardedWord2Vec, corpus_arrays
+from .vectorizers import Word2VecDataSetIterator

@@ -5,8 +5,11 @@ thread zoo, Spark parameter averaging, Aeron parameter server — SURVEY.md
 §2.4) with sharded jit over a jax.sharding.Mesh.
 """
 from .inference import InferenceMode, ParallelInference
-from .multihost import MultiHostRunner
+from .multihost import CheckpointManager, MultiHostRunner
 from .mesh import (DATA_AXIS, MODEL_AXIS, SEQ_AXIS, batch_sharded,
                    create_mesh, data_parallel_mesh, replicate, replicated,
                    shard_batch)
+from .param_server import (HttpParameterServerClient, ParameterServer,
+                           ParameterServerHttpNode, ParameterServerTrainer,
+                           remote_worker_fit)
 from .wrapper import ParallelWrapper

@@ -1,6 +1,7 @@
-"""Observability: StatsListener → StatsStorage → static report
+"""Observability: StatsListener → StatsStorage → static report + live UIServer
 (reference deeplearning4j-ui-parent, SURVEY.md §2.6/§5.5)."""
 from .remote import RemoteStatsStorageRouter, StatsReceiverServer
-from .report import export_json, render_html_report
+from .report import export_json, render_html, render_html_report
+from .server import UIServer
 from .stats import (FileStatsStorage, InMemoryStatsStorage, StatsListener,
                     StatsStorage, StatsUpdateConfiguration)
