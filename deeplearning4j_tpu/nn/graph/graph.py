@@ -306,14 +306,26 @@ class ComputationGraph(DeviceIterationMixin):
     # ------------------------------------------------------------------- fit
     def fit(self, data, labels=None, *, epochs: int = 1,
             batch_size: int = 32, step_fn=None, use_async: bool = True,
-            async_queue_size: int = 8) -> "ComputationGraph":
+            async_queue_size: int = 8, steps_per_dispatch: int = 1
+            ) -> "ComputationGraph":
         """Train (reference fit(MultiDataSetIterator):867). Accepts a
         MultiDataSet, DataSet, (features, labels) arrays, or an iterator of
         either. `step_fn` lets ParallelWrapper substitute a sharded step.
         Batches prefetch on a background thread (the reference wraps with
-        AsyncMultiDataSetIterator at :867) unless use_async=False."""
+        AsyncMultiDataSetIterator at :867) unless use_async=False.
+        `steps_per_dispatch > 1` groups same-shaped batches into one
+        fused lax.scan dispatch (see MultiLayerNetwork.fit)."""
         from ...data.iterators import AsyncMultiDataSetIterator
         self._check_init()
+        spd = int(steps_per_dispatch)
+        if spd > 1 and step_fn is not None:
+            raise ValueError("steps_per_dispatch cannot combine with a "
+                             "custom step_fn")
+        if spd > 1 and self.conf.backprop_type == \
+                BackpropType.TRUNCATED_BPTT:
+            raise NotImplementedError(
+                "steps_per_dispatch > 1 does not support truncated BPTT "
+                "iterators; use fit_batch_repeated for resident batches")
         step = step_fn or self.fit_batch
         if hasattr(data, "__iter__") and not isinstance(
                 data, (DataSet, MultiDataSet, list, tuple, np.ndarray)):
@@ -327,10 +339,35 @@ class ComputationGraph(DeviceIterationMixin):
         async_ok = getattr(iterator, "async_supported", lambda: True)()
         wrapped = AsyncMultiDataSetIterator(iterator, async_queue_size) \
             if (use_async and async_ok) else iterator
+        group: List[MultiDataSet] = []
+
+        def group_sig(m):
+            return (tuple(np.asarray(f).shape for f in m.features),
+                    tuple(np.asarray(l).shape for l in m.labels),
+                    m.features_masks is None, m.labels_masks is None)
+
+        def flush_group():
+            if not group:
+                return
+            if len(group) == 1:
+                step(group[0])
+            else:
+                self.fit_batches(group)
+            group.clear()
+
         try:
             for _ in range(epochs):
                 for ds in wrapped:
-                    step(self._coerce(ds))
+                    mds = self._coerce(ds)
+                    if spd <= 1:
+                        step(mds)
+                        continue
+                    if group and group_sig(mds) != group_sig(group[0]):
+                        flush_group()
+                    group.append(mds)
+                    if len(group) >= spd:
+                        flush_group()
+                flush_group()
                 self.epoch += 1
                 for lst in self.listeners:
                     if hasattr(lst, "on_epoch_end"):

@@ -274,15 +274,47 @@ class MultiLayerNetwork(DeviceIterationMixin):
     # ------------------------------------------------------------------- fit
     def fit(self, data, labels=None, *, epochs: int = 1, batch_size: int = 32,
             use_async: bool = True, async_queue_size: int = 8,
-            step_fn=None) -> "MultiLayerNetwork":
+            step_fn=None, steps_per_dispatch: int = 1
+            ) -> "MultiLayerNetwork":
         """Train (reference fit(DataSetIterator):1019). Accepts a
         DataSetIterator, a DataSet, or (features, labels) arrays. `step_fn`
-        lets ParallelWrapper reuse this loop with a sharded step."""
+        lets ParallelWrapper reuse this loop with a sharded step.
+
+        `steps_per_dispatch > 1` groups that many same-shaped minibatches
+        into ONE fused device dispatch (fit_batches' lax.scan —
+        bit-identical math, amortized dispatch latency). Odd-shaped
+        batches (e.g. a short final batch) flush the group and run
+        singly; incompatible with step_fn and truncated BPTT."""
         self._check_init()
+        spd = int(steps_per_dispatch)
+        if spd > 1 and step_fn is not None:
+            raise ValueError("steps_per_dispatch cannot combine with a "
+                             "custom step_fn")
+        if spd > 1 and self.conf.backprop_type == \
+                BackpropType.TRUNCATED_BPTT:
+            raise NotImplementedError(
+                "steps_per_dispatch > 1 does not support truncated BPTT "
+                "iterators; use fit_batch_repeated for resident batches")
         it = as_iterator(data, labels, batch_size)
         wrapped = AsyncDataSetIterator(it, async_queue_size) \
             if (use_async and it.async_supported()) else it
         step = step_fn or self._fit_batch
+        group: List[DataSet] = []
+
+        def group_sig(ds):
+            return (np.asarray(ds.features).shape,
+                    np.asarray(ds.labels).shape,
+                    ds.features_mask is None, ds.labels_mask is None)
+
+        def flush_group():
+            if not group:
+                return
+            if len(group) == 1:
+                step(group[0])
+            else:
+                self.fit_batches(group)
+            group.clear()
+
         import time as _time
         try:
             for _ in range(epochs):
@@ -297,7 +329,15 @@ class MultiLayerNetwork(DeviceIterationMixin):
                     except StopIteration:
                         break
                     self.last_etl_ms = (_time.perf_counter() - t0) * 1000.0
-                    step(ds)
+                    if spd <= 1:
+                        step(ds)
+                        continue
+                    if group and group_sig(ds) != group_sig(group[0]):
+                        flush_group()
+                    group.append(ds)
+                    if len(group) >= spd:
+                        flush_group()
+                flush_group()  # end of epoch: run the partial group
                 self.epoch += 1
                 for lst in self.listeners:
                     if hasattr(lst, "on_epoch_end"):

@@ -15,15 +15,52 @@ Routes:
   GET /                  live HTML overview (self-refreshing)
   GET /train/sessions    JSON session ids
   GET /train/data        JSON all updates of the newest session
+  GET /tsne              embedding scatter plot (attach_embedding /
+                         POST /tsne/upload — the tsne UI module role)
+  POST /tsne/upload      {"points": [[x,y],...], "labels": [...]}
 """
 from __future__ import annotations
 
 import threading
-from typing import Optional
+from typing import Optional, Sequence
+
+import numpy as np
 
 from ..utils.http_server import JsonHttpServer
 from .report import render_html
 from .stats import StatsStorage
+
+
+def _scatter_svg(points: np.ndarray, labels: Sequence[str],
+                 width=640, height=480, pad=24) -> str:
+    """2-D embedding scatter (the tsne module's view). Points colored by
+    label hash; labels legend capped at 12 entries."""
+    import html as _html
+    if len(points) == 0:
+        return "<svg></svg>"
+    p = np.asarray(points, np.float64)
+    lo, hi = p.min(0), p.max(0)
+    span = np.where(hi - lo > 0, hi - lo, 1.0)
+    xy = (p - lo) / span
+    uniq = []
+    for l in labels:
+        if l not in uniq:
+            uniq.append(l)
+    color = {l: f"hsl({(hash(str(l)) % 360)},65%,45%)" for l in uniq}
+    dots = "".join(
+        f'<circle cx="{pad + x * (width - 2 * pad):.1f}" '
+        f'cy="{height - pad - y * (height - 2 * pad):.1f}" r="3" '
+        f'fill="{color[l]}"><title>{_html.escape(str(l))}</title>'
+        f'</circle>'
+        for (x, y), l in zip(xy, labels))
+    legend = "".join(
+        f'<text x="{pad + 90 * i}" y="14" font-size="11" '
+        f'fill="{color[l]}">{_html.escape(str(l))[:10]}</text>'
+        for i, l in enumerate(uniq[:12]))
+    return (f'<svg viewBox="0 0 {width} {height}" width="{width}" '
+            f'height="{height}" xmlns="http://www.w3.org/2000/svg">'
+            f'<rect width="{width}" height="{height}" fill="#fafafa"/>'
+            f'{legend}{dots}</svg>')
 
 
 class UIServer:
@@ -36,11 +73,12 @@ class UIServer:
         self._storages: list[StatsStorage] = []
         self._lock = threading.Lock()
         self.refresh_seconds = float(refresh_seconds)
+        self._embedding = None  # (points [n,2], labels [n])
         self._server = JsonHttpServer(
             get_routes={"/train/sessions": self._sessions,
                         "/train/data": self._data},
-            post_routes={},
-            raw_get_routes={"/": self._index},
+            post_routes={"/tsne/upload": self._tsne_upload},
+            raw_get_routes={"/": self._index, "/tsne": self._tsne_page},
             port=port)
 
     # ----------------------------------------------------------- lifecycle
@@ -124,3 +162,37 @@ class UIServer:
         if st is None:
             return 404, {"error": "no attached session"}
         return 200, {"session": sid, "updates": st.get_updates(sid)}
+
+    # --------------------------------------------------------- tsne module
+    def attach_embedding(self, points, labels=None) -> "UIServer":
+        """Show a 2-D embedding on /tsne (the reference tsne UI module:
+        upload t-SNE coordinates, browse the scatter). Pairs naturally
+        with clustering.tsne.TSNE output."""
+        points = np.asarray(points, np.float64)
+        if points.ndim != 2 or points.shape[1] != 2:
+            raise ValueError(f"need [n, 2] points, got {points.shape}")
+        labels = [""] * len(points) if labels is None else \
+            [str(l) for l in labels]
+        if len(labels) != len(points):
+            raise ValueError("labels length != points length")
+        with self._lock:
+            self._embedding = (points, labels)
+        return self
+
+    def _tsne_upload(self, payload):
+        self.attach_embedding(payload["points"], payload.get("labels"))
+        return 200, {"count": len(payload["points"])}
+
+    def _tsne_page(self):
+        with self._lock:
+            emb = self._embedding
+        if emb is None:
+            body = ("<!doctype html><body>no embedding attached — "
+                    "attach_embedding(points, labels) or POST "
+                    "/tsne/upload</body>").encode()
+            return 200, "text/html; charset=utf-8", body
+        doc = (f"<!doctype html><html><head><meta charset='utf-8'>"
+               f"<title>t-SNE</title></head><body>"
+               f"<h1>Embedding ({len(emb[0])} points)</h1>"
+               f"{_scatter_svg(emb[0], emb[1])}</body></html>")
+        return 200, "text/html; charset=utf-8", doc.encode()

@@ -448,3 +448,31 @@ class TestFusedMultiStep:
         assert g._iteration_dev is None
         g.fit_batch(mds)
         assert g.iteration == 101
+
+
+class TestGraphStepsPerDispatch:
+    def test_fit_grouped_matches_plain(self):
+        def make():
+            conf = (NeuralNetConfiguration.builder().seed(5)
+                    .updater(Adam(0.01)).graph_builder()
+                    .add_inputs("in")
+                    .add_layer("d", DenseLayer(n_out=8, activation="tanh"),
+                               "in")
+                    .add_layer("out", OutputLayer(n_out=3,
+                                                  activation="softmax",
+                                                  loss="mcxent"), "d")
+                    .set_outputs("out")
+                    .set_input_types(InputType.feed_forward(5))
+                    .build())
+            return ComputationGraph(conf).init()
+        rng = np.random.default_rng(2)
+        x = rng.standard_normal((50, 5)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 50)]
+        g1, g2 = make(), make()
+        g1.fit(x, y, epochs=2, batch_size=16, use_async=False)
+        g2.fit(x, y, epochs=2, batch_size=16, use_async=False,
+               steps_per_dispatch=3)
+        assert g1.iteration == g2.iteration == 8
+        for a, b in zip(jax.tree_util.tree_leaves(g1.params_tree),
+                        jax.tree_util.tree_leaves(g2.params_tree)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

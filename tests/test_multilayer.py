@@ -312,3 +312,38 @@ class TestFusedTbpttRepeat:
         net.fit_batch_repeated(ds, 2)  # 2 repeats x 2 windows
         assert net.iteration == 4
         assert seen == [2, 4]  # one event per repeat, at its last window
+
+
+class TestStepsPerDispatch:
+    def test_fit_grouped_matches_plain(self):
+        conf = lambda: (NeuralNetConfiguration.builder().seed(4)
+                        .updater(Adam(0.01)).list()
+                        .layer(DenseLayer(n_out=8, activation="tanh"))
+                        .layer(OutputLayer(n_out=3, activation="softmax",
+                                           loss="mcxent"))
+                        .set_input_type(InputType.feed_forward(5)).build())
+        rng = np.random.default_rng(0)
+        # 50 rows at batch 16 -> 3 full batches + one short (grouping
+        # must flush on the shape change)
+        x = rng.standard_normal((50, 5)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 50)]
+        n1 = MultiLayerNetwork(conf()).init()
+        n1.fit(x, y, epochs=2, batch_size=16, use_async=False)
+        n2 = MultiLayerNetwork(conf()).init()
+        n2.fit(x, y, epochs=2, batch_size=16, use_async=False,
+               steps_per_dispatch=2)
+        assert n1.iteration == n2.iteration == 8
+        for a, b in zip(jax.tree_util.tree_leaves(n1.params_tree),
+                        jax.tree_util.tree_leaves(n2.params_tree)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_incompatible_combinations_raise(self):
+        conf = (NeuralNetConfiguration.builder().updater(Adam(0.01)).list()
+                .layer(OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        x = np.zeros((8, 4), np.float32)
+        y = np.eye(2, dtype=np.float32)[np.zeros(8, int)]
+        with pytest.raises(ValueError, match="step_fn"):
+            net.fit(x, y, steps_per_dispatch=2, step_fn=lambda ds: None)

@@ -140,3 +140,54 @@ class TestUIServer:
         assert 'http-equiv="refresh"' not in doc
         assert 'http-equiv="refresh"' in render_html(
             storage, refresh_seconds=1.0)
+
+
+class TestTsneModule:
+    """The tsne UI module role (PlayUIServer's tsne tab): attach or
+    upload a 2-D embedding, browse the scatter."""
+
+    def test_attach_and_view(self):
+        server = UIServer(port=0).start()
+        try:
+            page = _get(server.url + "/tsne").decode()
+            assert "no embedding attached" in page
+            rng = np.random.default_rng(0)
+            pts = rng.standard_normal((30, 2))
+            labels = [f"c{i % 3}" for i in range(30)]
+            server.attach_embedding(pts, labels)
+            page = _get(server.url + "/tsne").decode()
+            assert page.count("<circle") == 30
+            assert "c0" in page and "c2" in page
+        finally:
+            server.stop()
+
+    def test_upload_route(self):
+        import urllib.request
+        server = UIServer(port=0).start()
+        try:
+            body = json.dumps({"points": [[0, 0], [1, 1]],
+                               "labels": ["a", "b"]}).encode()
+            req = urllib.request.Request(
+                server.url + "/tsne/upload", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                assert json.loads(r.read())["count"] == 2
+            page = _get(server.url + "/tsne").decode()
+            assert page.count("<circle") == 2
+        finally:
+            server.stop()
+
+    def test_pairs_with_tsne_clustering(self):
+        from deeplearning4j_tpu.clustering.tsne import Tsne
+        rng = np.random.default_rng(1)
+        x = np.concatenate([rng.normal(0, 0.3, (15, 8)),
+                            rng.normal(3, 0.3, (15, 8))]).astype(np.float32)
+        emb = Tsne(n_components=2, n_iter=30, seed=2).fit_transform(x)
+        server = UIServer(port=0).start()
+        try:
+            server.attach_embedding(np.asarray(emb),
+                                    ["a"] * 15 + ["b"] * 15)
+            page = _get(server.url + "/tsne").decode()
+            assert page.count("<circle") == 30
+        finally:
+            server.stop()
