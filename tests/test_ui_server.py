@@ -182,7 +182,8 @@ class TestTsneModule:
         rng = np.random.default_rng(1)
         x = np.concatenate([rng.normal(0, 0.3, (15, 8)),
                             rng.normal(3, 0.3, (15, 8))]).astype(np.float32)
-        emb = Tsne(n_components=2, n_iter=30, seed=2).fit_transform(x)
+        emb = Tsne(n_components=2, perplexity=8.0, n_iter=30,
+                   seed=2).fit_transform(x)
         server = UIServer(port=0).start()
         try:
             server.attach_embedding(np.asarray(emb),
