@@ -127,6 +127,38 @@ def bench_resnet50(batch=1024, steps=10, repeats=3):
     return (batch * steps) / dt
 
 
+def bench_vgg16(batch=256, steps=10, repeats=3):
+    """zoo VGG16 ImageNet-shape training img/s/chip (the BASELINE.md
+    companion row to ResNet50; reference zoo/model/VGG16.java). bf16,
+    fused multi-step loop."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.models import VGG16
+    from deeplearning4j_tpu.data.dataset import DataSet
+
+    net = VGG16(num_labels=1000).init(dtype=jnp.bfloat16)
+    rng = np.random.default_rng(0)
+    x = jax.device_put(jnp.asarray(
+        rng.standard_normal((batch, 224, 224, 3)), jnp.bfloat16))
+    y = jax.device_put(
+        np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, batch)])
+    ds = DataSet(x, y)
+    net.fit_batch_repeated(ds, steps)
+    float(net.score_value)  # fence (compile + warm)
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        net.fit_batch_repeated(ds, steps)
+        float(net.score_value)
+        times.append(time.perf_counter() - t0)
+    dt = sorted(times)[len(times) // 2]
+    return (batch * steps) / dt
+
+
+# VGG16 fwd FLOPs at 224x224 (standard multiply-add=2 count); train ~3x.
+VGG16_TRAIN_FLOPS_PER_IMAGE = 3 * 15.5e9
+
+
 def bench_lstm(batch=128, seq_len=64, steps=30, repeats=3):
     """GravesLSTM char-RNN tokens/sec (zoo TextGenerationLSTM workload;
     reference zoo/model/TextGenerationLSTM.java)."""
@@ -307,6 +339,13 @@ def main():
         metric = "word2vec_skipgram_ns_words_per_sec"
         unit = "words/sec"
         extra = {}
+    elif workload == "vgg16":
+        ips = bench_vgg16()
+        metric = "vgg16_imagenet_bf16_images_per_sec_per_chip"
+        flops = ips * VGG16_TRAIN_FLOPS_PER_IMAGE
+        extra = {"est_mfu": round(flops / TPU_V5E_BF16_PEAK, 3),
+                 "est_mfu_achievable": round(
+                     flops / TPU_V5E_BF16_ACHIEVABLE, 3)}
     elif workload == "etl":
         ips = bench_etl()
         metric = "host_image_etl_images_per_sec"
@@ -325,7 +364,7 @@ def main():
                      flops / TPU_V5E_BF16_ACHIEVABLE, 3)}
     else:
         raise SystemExit(f"Unknown workload {workload!r}; use "
-                         "resnet50 [batch] | lenet | lstm | w2v | etl | lenet_hostfed")
+                         "resnet50 [batch] | vgg16 | lenet | lstm | w2v | etl | lenet_hostfed")
     print(json.dumps({
         "metric": metric,
         "value": round(ips, 1),

@@ -155,7 +155,14 @@ class Updater:
         flat_s = treedef.flatten_up_to(state)
         out = [self.apply(g, s, lr, iteration) for g, s in zip(flat_g, flat_s)]
         updates = treedef.unflatten([u for u, _ in out])
-        new_state = treedef.unflatten([s for _, s in out])
+        # State dtype is a CONTRACT (init_state uses zeros_like(param)):
+        # the f32 learning-rate scalar must not promote bf16 optimizer
+        # state to f32 across a step — that silently doubles state HBM
+        # and breaks scan carries / donation aliasing.
+        new_state = treedef.unflatten([
+            jax.tree_util.tree_map(lambda n, o: n.astype(o.dtype), s_new,
+                                   s_old)
+            for (_, s_new), s_old in zip(out, flat_s)])
         return updates, new_state
 
 
