@@ -416,3 +416,12 @@ class TestDPxRecurrent:
         pw = ParallelWrapper(dp, mesh=data_parallel_mesh(8))
         with pytest.raises(ValueError, match="must divide"):
             pw.fit_batch(ds)
+
+    def test_graph_tbptt_local_sgd_rejected_loudly(self):
+        from deeplearning4j_tpu.data.dataset import MultiDataSet
+        g = self._graph_rnn(seed=13)
+        pw = ParallelWrapper(g, mesh=data_parallel_mesh(4),
+                             averaging_frequency=2)
+        ds = self._rnn_data(seed=4)
+        with pytest.raises(NotImplementedError, match="averaging_freq"):
+            pw.fit_batch(MultiDataSet([ds.features], [ds.labels]))
