@@ -300,6 +300,36 @@ class MultiHostRunner:
         wrapper.finalize()
         return wrapper
 
+    # ------------------------------------------------------------ evaluation
+    def evaluate(self, model, local_features, local_labels=None, *,
+                 batch_size: int = 128):
+        """Distributed evaluation: every process evaluates ITS partition
+        locally, per-process confusion statistics allgather across the
+        cluster, and the merged Evaluation returns everywhere (the
+        reference's evaluation flatmap + reduce —
+        `spark/impl/multilayer/evaluation/` evaluate() aggregating
+        per-partition Evaluation objects via merge)."""
+        local = model.evaluate(local_features, local_labels,
+                               batch_size=batch_size)
+        if jax.process_count() == 1:
+            return local
+        import pickle
+
+        from jax.experimental import multihost_utils
+        blob = np.frombuffer(pickle.dumps(local), np.uint8)
+        # fixed-size lockstep transport: allgather needs equal shapes
+        size = np.asarray([blob.size], np.int64)
+        sizes = multihost_utils.process_allgather(size).reshape(-1)
+        cap = int(sizes.max())
+        padded = np.zeros(cap, np.uint8)
+        padded[:blob.size] = blob
+        gathered = multihost_utils.process_allgather(padded)
+        merged = None
+        for row, n in zip(np.asarray(gathered).reshape(-1, cap), sizes):
+            ev = pickle.loads(bytes(row[:int(n)]))
+            merged = ev if merged is None else merged.merge(ev)
+        return merged
+
     # --------------------------------------------------------- repartitioning
     @staticmethod
     def balanced_partition(n: int, num_partitions: int, partition: int

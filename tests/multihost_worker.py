@@ -129,5 +129,11 @@ runner.barrier("ckpt-read")  # both processes read before chief removes
 if pid == 0:
     os.remove(ckpt)
 
+# Phase 4: distributed evaluation (evaluation flatmap + merge role) —
+# every process scores its partition; the merged Evaluation must count
+# ALL rows and agree across processes.
+ev = runner.evaluate(net, xs, ys, batch_size=16)
+print(f"EVAL {pid} {ev.num_examples()} {ev.accuracy():.6f}", flush=True)
+
 runner.barrier("done")
 print(f"DONE {pid}", flush=True)

@@ -204,3 +204,39 @@ class TestBalancedPartition:
         from deeplearning4j_tpu.parallel.multihost import MultiHostRunner
         with _pytest.raises(ValueError):
             MultiHostRunner.balanced_partition(10, 4, 4)
+
+
+class TestDistributedEvaluation:
+    """Reference spark/impl/multilayer/evaluation role: per-partition
+    Evaluation objects merge across the cluster."""
+
+    def test_merged_eval_counts_all_rows_and_agrees(self, multihost_output):
+        vals = {}
+        for out in multihost_output:
+            for m in re.finditer(r"^EVAL (\d+) (\d+) ([\d.]+)$", out, re.M):
+                vals[int(m.group(1))] = (int(m.group(2)),
+                                         float(m.group(3)))
+        assert set(vals) == {0, 1}, multihost_output
+        # each process holds 32 local rows; the merged eval saw all 64
+        assert vals[0][0] == vals[1][0] == 64
+        assert abs(vals[0][1] - vals[1][1]) < 1e-9
+
+    def test_single_process_evaluate_passthrough(self):
+        from deeplearning4j_tpu import (DenseLayer, InputType,
+                                        MultiLayerNetwork,
+                                        NeuralNetConfiguration, OutputLayer,
+                                        Sgd)
+        from deeplearning4j_tpu.parallel import MultiHostRunner
+        conf = (NeuralNetConfiguration.builder().seed(1).updater(Sgd(0.1))
+                .list()
+                .layer(DenseLayer(n_out=8, activation="tanh"))
+                .layer(OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((20, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 20)]
+        runner = MultiHostRunner().initialize()
+        ev = runner.evaluate(net, x, y)
+        assert ev.num_examples() == 20
