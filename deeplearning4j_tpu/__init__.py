@@ -21,6 +21,7 @@ from .nn.layers.convolution import (BatchNormalization, Convolution1DLayer,
                                     ZeroPaddingLayer)
 from .nn.layers.pretrain import (RBM, AutoEncoder, CenterLossOutputLayer,
                                  VariationalAutoencoder)
+from .nn.layers.attention import SelfAttentionLayer
 from .nn.layers.recurrent import (LSTM, GravesBidirectionalLSTM, GravesLSTM,
                                   RnnOutputLayer)
 from .nn.multilayer import MultiLayerNetwork

@@ -1,0 +1,85 @@
+"""Self-attention layer for recurrent-shaped ([batch, time, features])
+data.
+
+BEYOND-parity scope (the reference predates attention; SURVEY.md §5.7):
+long-context is first-class on TPU, so the framework ships a
+multi-head self-attention layer on the standard Layer SPI — configs
+serialize, gradients autodiff, masks flow like every recurrent layer —
+plus the sequence-parallel ring kernel in ops/attention.py for
+sequences too long for one device.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from ...ops.attention import dense_attention
+from ...utils import serde
+from .core import Layer, dropout
+
+W_Q, W_K, W_V, W_O = "Wq", "Wk", "Wv", "Wo"
+B_Q, B_K, B_V, B_O = "bq", "bk", "bv", "bo"
+
+
+@serde.register
+@dataclass
+class SelfAttentionLayer(Layer):
+    """Multi-head self-attention over [batch, time, features]; output
+    [batch, time, n_out]. `causal=True` masks future positions (the
+    autoregressive/char-RNN setting); the feature mask (like every
+    recurrent layer's) hides padded timesteps as attention KEYS."""
+
+    n_in: int = 0
+    n_out: int = 0
+    n_heads: int = 4
+    causal: bool = False
+
+    def input_kind(self):
+        return "rnn"
+
+    def set_input_type(self, input_type):
+        from ..conf.inputs import RecurrentType
+        if not isinstance(input_type, RecurrentType):
+            raise ValueError(
+                f"SelfAttentionLayer needs RNN input, got {input_type}")
+        if self.n_in == 0:
+            self.n_in = input_type.size
+        if self.n_out == 0:
+            self.n_out = self.n_in
+        if self.n_out % self.n_heads:
+            raise ValueError(f"n_out={self.n_out} must divide into "
+                             f"{self.n_heads} heads")
+        return RecurrentType(size=self.n_out,
+                             timeseries_length=input_type.timeseries_length)
+
+    def has_params(self):
+        return True
+
+    def init_params(self, key, dtype=jnp.float32):
+        import jax
+        kq, kk, kv, ko = jax.random.split(key, 4)
+        E, M = self.n_in, self.n_out
+        p = {}
+        for name, k_, (i, o) in ((W_Q, kq, (E, M)), (W_K, kk, (E, M)),
+                                 (W_V, kv, (E, M)), (W_O, ko, (M, M))):
+            p[name] = self._winit(k_, (i, o), i, o, dtype)
+        for name, n in ((B_Q, M), (B_K, M), (B_V, M), (B_O, M)):
+            p[name] = jnp.zeros((n,), dtype)
+        return p
+
+    def forward(self, params, state, x, *, train=False, rng=None,
+                mask=None):
+        x = dropout(x, self.dropout_rate, train, rng)
+        b, t, _ = x.shape
+        h = self.n_heads
+        d = self.n_out // h
+        q = (x @ params[W_Q] + params[B_Q]).reshape(b, t, h, d)
+        k = (x @ params[W_K] + params[B_K]).reshape(b, t, h, d)
+        v = (x @ params[W_V] + params[B_V]).reshape(b, t, h, d)
+        out = dense_attention(q, k, v, causal=self.causal, key_mask=mask)
+        out = out.reshape(b, t, self.n_out)
+        out = out @ params[W_O] + params[B_O]
+        if mask is not None:
+            out = out * mask[..., None].astype(out.dtype)
+        return self._act()(out), state

@@ -1,0 +1,114 @@
+"""Attention: dense MHA layer on the Layer SPI + ring attention
+(sequence/context parallelism) over the mesh seq axis. BEYOND-parity
+scope — the reference predates attention (SURVEY.md §5.7)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (Adam, DataSet, InputType, MultiLayerNetwork,
+                                NeuralNetConfiguration, RnnOutputLayer)
+from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer
+from deeplearning4j_tpu.ops.attention import (dense_attention,
+                                              ring_self_attention)
+from deeplearning4j_tpu.parallel.mesh import SEQ_AXIS, create_mesh
+
+
+class TestRingAttention:
+    def _qkv(self, seed=0, B=2, T=32, H=4, D=16):
+        rng = np.random.default_rng(seed)
+        mk = lambda: jnp.asarray(rng.standard_normal((B, T, H, D)),
+                                 jnp.float32)
+        return mk(), mk(), mk()
+
+    @pytest.fixture
+    def mesh(self):
+        return create_mesh([8], (SEQ_AXIS,), jax.devices())
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_dense(self, mesh, causal):
+        q, k, v = self._qkv()
+        ref = dense_attention(q, k, v, causal=causal)
+        ring = ring_self_attention(q, k, v, mesh, causal=causal)
+        np.testing.assert_allclose(np.asarray(ring), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_matches_dense_with_key_mask(self, mesh):
+        q, k, v = self._qkv(seed=1)
+        rng = np.random.default_rng(2)
+        km = jnp.asarray(rng.random((2, 32)) > 0.3, jnp.float32)
+        ref = dense_attention(q, k, v, causal=True, key_mask=km)
+        ring = ring_self_attention(q, k, v, mesh, causal=True,
+                                   key_mask=km)
+        np.testing.assert_allclose(np.asarray(ring), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_indivisible_time_rejected(self, mesh):
+        q, k, v = self._qkv(T=30)
+        with pytest.raises(ValueError, match="divide"):
+            ring_self_attention(q, k, v, mesh)
+
+
+class TestSelfAttentionLayer:
+    def _conf(self, causal=False):
+        return (NeuralNetConfiguration.builder().seed(3)
+                .updater(Adam(0.01))
+                .list()
+                .layer(SelfAttentionLayer(n_out=16, n_heads=4,
+                                          causal=causal))
+                .layer(RnnOutputLayer(n_out=3, activation="softmax",
+                                      loss="mcxent"))
+                .set_input_type(InputType.recurrent(8))
+                .build())
+
+    def test_gradient_check(self):
+        # f64 like every other gradient check (f32 central differences
+        # bottom out at a few percent relative error)
+        from deeplearning4j_tpu.utils.gradient_check import \
+            gradient_check_mln
+        jax.config.update("jax_enable_x64", True)
+        try:
+            net = MultiLayerNetwork(self._conf()).init(dtype=jnp.float64)
+            rng = np.random.default_rng(0)
+            x = rng.standard_normal((2, 6, 8))
+            y = np.eye(3)[rng.integers(0, 3, (2, 6))]
+            assert gradient_check_mln(net, x, y)
+        finally:
+            jax.config.update("jax_enable_x64", False)
+
+    def test_causality(self):
+        """With causal=True, output at time t must not depend on inputs
+        after t."""
+        net = MultiLayerNetwork(self._conf(causal=True)).init()
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((1, 8, 8)).astype(np.float32)
+        base = net.output(x)
+        x2 = x.copy()
+        x2[:, 5:] += 10.0  # perturb the future
+        out2 = net.output(x2)
+        np.testing.assert_allclose(base[:, :5], out2[:, :5], rtol=1e-4,
+                                   atol=1e-5)
+        assert np.abs(base[:, 5:] - out2[:, 5:]).max() > 1e-3
+
+    def test_learns_sequence_task(self):
+        """Classify each timestep by the sequence's FIRST token — only
+        solvable by attending across time."""
+        rng = np.random.default_rng(4)
+        n, T = 128, 6
+        first = rng.integers(0, 3, n)
+        x = rng.standard_normal((n, T, 8)).astype(np.float32) * 0.1
+        x[np.arange(n), 0, first] += 2.0
+        y = np.zeros((n, T, 3), np.float32)
+        y[np.arange(n)[:, None], np.arange(T)[None, :], first[:, None]] = 1
+        net = MultiLayerNetwork(self._conf()).init()
+        net.fit(DataSet(x, y), epochs=60, batch_size=64)
+        pred = net.output(x)
+        acc = float((pred.argmax(-1) == first[:, None]).mean())
+        assert acc > 0.9, acc
+
+    def test_serde_roundtrip(self):
+        from deeplearning4j_tpu.utils import serde
+        layer = SelfAttentionLayer(n_in=8, n_out=16, n_heads=2,
+                                   causal=True)
+        back = serde.from_json(serde.to_json(layer))
+        assert back == layer
