@@ -519,7 +519,7 @@ class ComputationGraph(DeviceIterationMixin):
         self._check_init()
         for name in self._layer_nodes:
             layer = self.conf.nodes[name].layer
-            if layer.is_recurrent() and not layer.supports_streaming():
+            if not layer.supports_streaming():
                 raise NotImplementedError(
                     f"{type(layer).__name__} ({name!r}) does not support "
                     "rnn_time_step")

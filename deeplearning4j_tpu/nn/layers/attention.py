@@ -56,6 +56,17 @@ class SelfAttentionLayer(Layer):
     def has_params(self):
         return True
 
+    def supports_streaming(self):
+        return False  # attention needs the full sequence (rnn_time_step
+        # over single steps would softmax each step against itself)
+
+    def param_reg(self, pname):
+        if pname in (W_Q, W_K, W_V, W_O):
+            return (self.l1 or 0.0, self.l2 or 0.0)
+        if pname in (B_Q, B_K, B_V, B_O):
+            return (self.l1_bias or 0.0, self.l2_bias or 0.0)
+        return (0.0, 0.0)
+
     def init_params(self, key, dtype=jnp.float32):
         import jax
         kq, kk, kv, ko = jax.random.split(key, 4)
@@ -80,6 +91,9 @@ class SelfAttentionLayer(Layer):
         out = dense_attention(q, k, v, causal=self.causal, key_mask=mask)
         out = out.reshape(b, t, self.n_out)
         out = out @ params[W_O] + params[B_O]
+        out = self._act()(out)
         if mask is not None:
+            # zero masked timesteps POST-activation (the recurrent-layer
+            # convention: padded steps output exactly 0)
             out = out * mask[..., None].astype(out.dtype)
-        return self._act()(out), state
+        return out, state

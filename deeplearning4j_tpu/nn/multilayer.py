@@ -699,7 +699,9 @@ class MultiLayerNetwork(DeviceIterationMixin):
         (GravesBidirectionalLSTM, like the reference)."""
         self._check_init()
         for layer in self.layers:
-            if layer.is_recurrent() and not layer.supports_streaming():
+            # any full-sequence layer (bidirectional LSTM, attention)
+            # must reject streaming, recurrent or not
+            if not layer.supports_streaming():
                 raise NotImplementedError(
                     f"{type(layer).__name__} does not support rnn_time_step "
                     "(needs the full sequence)")

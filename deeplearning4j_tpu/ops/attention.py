@@ -93,11 +93,12 @@ def _ring_body(axis: str, n_dev: int, t_loc: int, causal: bool):
             l = l * corr + p.sum(-1)
             o = o * corr[..., None] + jnp.einsum(
                 "bhqk,bkhd->bhqd", p, v_blk.astype(acc))
-            perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
-            k_blk = jax.lax.ppermute(k_blk, axis, perm)
-            v_blk = jax.lax.ppermute(v_blk, axis, perm)
-            if km_blk is not None:
-                km_blk = jax.lax.ppermute(km_blk, axis, perm)
+            if s < n_dev - 1:  # the last block is never needed again
+                perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+                k_blk = jax.lax.ppermute(k_blk, axis, perm)
+                v_blk = jax.lax.ppermute(v_blk, axis, perm)
+                if km_blk is not None:
+                    km_blk = jax.lax.ppermute(km_blk, axis, perm)
             return new_m, l, o, k_blk, v_blk, km_blk
 
         carry = (m, l, o, k, v, key_mask)
