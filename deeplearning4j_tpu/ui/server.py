@@ -74,11 +74,13 @@ class UIServer:
         self._lock = threading.Lock()
         self.refresh_seconds = float(refresh_seconds)
         self._embedding = None  # (points [n,2], labels [n])
+        self._model = None   # network shown on /model (flow module)
         self._server = JsonHttpServer(
             get_routes={"/train/sessions": self._sessions,
                         "/train/data": self._data},
             post_routes={"/tsne/upload": self._tsne_upload},
-            raw_get_routes={"/": self._index, "/tsne": self._tsne_page},
+            raw_get_routes={"/": self._index, "/tsne": self._tsne_page,
+                            "/model": self._model_page},
             port=port)
 
     # ----------------------------------------------------------- lifecycle
@@ -162,6 +164,65 @@ class UIServer:
         if st is None:
             return 404, {"error": "no attached session"}
         return 200, {"session": sid, "updates": st.get_updates(sid)}
+
+    # --------------------------------------------------------- flow module
+    def attach_model(self, net) -> "UIServer":
+        """Show the network's architecture on /model (the reference flow
+        UI module: layer boxes in execution order with connections).
+        Works for MultiLayerNetwork (chain) and ComputationGraph (DAG in
+        topological order)."""
+        with self._lock:
+            self._model = net
+        return self
+
+    def _model_page(self):
+        with self._lock:
+            net = self._model
+        if net is None:
+            return (200, "text/html; charset=utf-8",
+                    b"<!doctype html><body>no model attached - "
+                    b"attach_model(net)</body>")
+        import html as _html
+        rows = []
+        if hasattr(net, "layers"):  # MultiLayerNetwork chain
+            for i, layer in enumerate(net.layers):
+                rows.append((f"layer{i}", type(layer).__name__,
+                             [f"layer{i-1}"] if i else []))
+        else:  # ComputationGraph DAG
+            for name in net.conf.topo_order:
+                node = net.conf.nodes[name]
+                kind = type(node.layer if node.is_layer()
+                            else node.vertex).__name__
+                rows.append((name, kind, list(node.inputs)))
+        ypos = {name: 26 + i * 44 for i, (name, _, _) in enumerate(rows)}
+        boxes, edges = [], []
+        for name, kind, inputs in rows:
+            y = ypos[name]
+            boxes.append(
+                f'<rect x="150" y="{y}" width="340" height="32" rx="6" '
+                f'fill="#eef4ff" stroke="#88a"/>'
+                f'<text x="160" y="{y + 20}" font-size="12">'
+                f'{_html.escape(name)}: {_html.escape(kind)}</text>')
+            for src in inputs:
+                if src in ypos:
+                    edges.append(
+                        f'<line x1="320" y1="{ypos[src] + 32}" x2="320" '
+                        f'y2="{y}" stroke="#668" marker-end="url(#a)"/>')
+                else:  # network input
+                    edges.append(
+                        f'<text x="40" y="{y + 20}" font-size="11" '
+                        f'fill="#486">{_html.escape(src)} &#8594;</text>')
+        h = 26 + len(rows) * 44 + 20
+        doc = (f"<!doctype html><html><head><meta charset='utf-8'>"
+               f"<title>Model</title></head><body><h1>Model "
+               f"({len(rows)} nodes)</h1>"
+               f'<svg viewBox="0 0 640 {h}" width="640" height="{h}" '
+               f'xmlns="http://www.w3.org/2000/svg">'
+               f'<defs><marker id="a" markerWidth="8" markerHeight="8" '
+               f'refX="6" refY="3" orient="auto">'
+               f'<path d="M0,0 L6,3 L0,6 z" fill="#668"/></marker></defs>'
+               f'{"".join(edges)}{"".join(boxes)}</svg></body></html>')
+        return 200, "text/html; charset=utf-8", doc.encode()
 
     # --------------------------------------------------------- tsne module
     def attach_embedding(self, points, labels=None) -> "UIServer":

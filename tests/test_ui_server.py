@@ -192,3 +192,49 @@ class TestTsneModule:
             assert page.count("<circle") == 30
         finally:
             server.stop()
+
+
+class TestModelFlowModule:
+    """The flow UI module role: network architecture rendered as boxes
+    in execution order with connections."""
+
+    def test_mln_chain(self):
+        server = UIServer(port=0).start()
+        try:
+            page = _get(server.url + "/model").decode()
+            assert "no model attached" in page
+            server.attach_model(_net())
+            page = _get(server.url + "/model").decode()
+            assert "DenseLayer" in page and "OutputLayer" in page
+            assert page.count("<rect") == 2
+            assert "<line" in page  # the chain edge
+        finally:
+            server.stop()
+
+    def test_graph_dag(self):
+        from deeplearning4j_tpu import (Adam, ComputationGraph, DenseLayer,
+                                        InputType, NeuralNetConfiguration,
+                                        OutputLayer)
+        from deeplearning4j_tpu.nn.graph.vertices import MergeVertex
+        conf = (NeuralNetConfiguration.builder().updater(Adam(0.01))
+                .graph_builder().add_inputs("in")
+                .add_layer("a", DenseLayer(n_out=4, activation="relu"),
+                           "in")
+                .add_layer("b", DenseLayer(n_out=4, activation="tanh"),
+                           "in")
+                .add_vertex("m", MergeVertex(), "a", "b")
+                .add_layer("out", OutputLayer(n_out=2,
+                                              activation="softmax",
+                                              loss="mcxent"), "m")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(4)).build())
+        g = ComputationGraph(conf).init()
+        server = UIServer(port=0).start()
+        try:
+            server.attach_model(g)
+            page = _get(server.url + "/model").decode()
+            assert "MergeVertex" in page
+            assert page.count("<rect") == 4  # a, b, m, out
+            assert "in &#8594;" in page  # network-input arrows
+        finally:
+            server.stop()
