@@ -43,10 +43,20 @@ from ..nn.updaters import normalize_layer_gradients
 log = logging.getLogger(__name__)
 
 
+def _layer_map(net):
+    """(key, layer) pairs addressing the net's params/opt trees: indexed
+    tuple for MultiLayerNetwork, name-keyed dict for ComputationGraph —
+    the reference ParameterServerTrainer drives any Model."""
+    if hasattr(net, "layers"):
+        return list(enumerate(net.layers)), tuple
+    return ([(name, net.conf.nodes[name].layer)
+             for name in net._layer_nodes], dict)
+
+
 class ParameterServer:
     """In-process parameter host (ParameterServerNode role)."""
 
-    def __init__(self, net: MultiLayerNetwork, max_staleness: int = 2,
+    def __init__(self, net, max_staleness: int = 2,
                  device: Optional[jax.Device] = None):
         self._net = net
         self.device = device or jax.local_devices()[0]
@@ -57,25 +67,29 @@ class ParameterServer:
         self.applied = 0
         self.params = jax.device_put(net.params_tree, self.device)
         self.opt_state = jax.device_put(net.opt_state, self.device)
-        layers = net.layers
+        entries, container = _layer_map(net)
 
         def apply_update(params, opt_state, iteration, grads):
-            new_params, new_opt = [], []
-            for i, layer in enumerate(layers):
+            new_params, new_opt = {}, {}
+            for key, layer in entries:
                 g = normalize_layer_gradients(
-                    grads[i], layer.gradient_normalization,
+                    grads[key], layer.gradient_normalization,
                     layer.gradient_normalization_threshold)
                 updates, opt_i = layer.updater.update(
-                    g, opt_state[i], iteration)
+                    g, opt_state[key], iteration)
                 if layer.frozen:
-                    new_params.append(params[i])
-                    new_opt.append(opt_state[i])
+                    new_params[key] = params[key]
+                    new_opt[key] = opt_state[key]
                 else:
-                    new_params.append(jax.tree_util.tree_map(
-                        lambda p, u: p - u.astype(p.dtype), params[i],
-                        updates))
-                    new_opt.append(opt_i)
-            return tuple(new_params), tuple(new_opt)
+                    new_params[key] = jax.tree_util.tree_map(
+                        lambda p, u: p - u.astype(p.dtype), params[key],
+                        updates)
+                    new_opt[key] = opt_i
+            if container is tuple:
+                n = len(entries)
+                return (tuple(new_params[i] for i in range(n)),
+                        tuple(new_opt[i] for i in range(n)))
+            return new_params, new_opt
 
         # NO buffer donation here: pull() hands out references to the
         # live param buffers, and a donated apply would delete them under
@@ -109,18 +123,17 @@ class ParameterServer:
 
 class ParameterServerTrainer:
     """Async DP fit loop (ParameterServerTrainerContext role): one
-    worker thread per device, round-robin minibatch feed, no barrier."""
+    worker thread per device, round-robin minibatch feed, no barrier.
+    Drives MultiLayerNetwork and ComputationGraph (single-input)."""
 
-    def __init__(self, net: MultiLayerNetwork,
+    def __init__(self, net,
                  workers: Optional[int] = None,
                  devices: Optional[List[jax.Device]] = None,
                  max_staleness: int = 2, queue_size: int = 4):
-        if not isinstance(net, MultiLayerNetwork):
-            raise NotImplementedError(
-                "ParameterServerTrainer drives MultiLayerNetwork; use "
-                "ParallelWrapper for ComputationGraph data parallelism")
         net._check_init()
-        if any(len(st) for st in net.state_tree):
+        states = (net.state_tree.values()
+                  if isinstance(net.state_tree, dict) else net.state_tree)
+        if any(len(st) for st in states):
             # BN running stats etc. have no well-defined owner under
             # asynchronous updates (whose statistics win?); the sync
             # paths commit state, this one cannot — reject loudly
@@ -138,13 +151,27 @@ class ParameterServerTrainer:
         self.queue_size = int(queue_size)
         self.losses: List[float] = []
 
-        def loss_and_grads(params, state, rng, x, y, fmask, lmask):
+        # both network classes expose _loss_pure(params, state, DATA...,
+        # rng, train); the worker packs DataSets into the right DATA args
+        def loss_and_grads(params, state, rng, *data):
             (loss, _), grads = jax.value_and_grad(
                 net._loss_pure, has_aux=True)(
-                    params, state, x, y, fmask, lmask, rng, True)
+                    params, state, *data, rng, True)
             return loss, grads
 
         self._grad_fn = jax.jit(loss_and_grads)
+        self._is_graph = not hasattr(net, "layers")
+
+    def _pack_item(self, item):
+        """(x, y, fmask, lmask) → the net's _loss_pure data args."""
+        x, y, fmask, lmask = item
+        if not self._is_graph:
+            return (x, y, fmask, lmask)
+        from ..data.dataset import MultiDataSet
+        mds = MultiDataSet([np.asarray(x)], [np.asarray(y)],
+                           None if fmask is None else [np.asarray(fmask)],
+                           None if lmask is None else [np.asarray(lmask)])
+        return self.net._pack(mds)
 
     def _worker(self, wid: int, q: "queue.Queue", errors: list,
                 stop: threading.Event):
@@ -159,14 +186,12 @@ class ParameterServerTrainer:
                     continue
                 if item is None:
                     return
-                x, y, fmask, lmask = item
-                x = jax.device_put(x, dev)
-                y = jax.device_put(y, dev)
+                data = jax.device_put(self._pack_item(item), dev)
                 while True:
                     version, params = self.server.pull(dev)
                     rng, sub = jax.random.split(rng)
-                    loss, grads = self._grad_fn(params, state, sub, x, y,
-                                                fmask, lmask)
+                    loss, grads = self._grad_fn(params, state, sub,
+                                                *data)
                     if self.server.push(version, grads):
                         self.losses.append(float(loss))
                         break
@@ -340,7 +365,7 @@ class HttpParameterServerClient:
         return self._get("/stats")
 
 
-def remote_worker_fit(net: MultiLayerNetwork, url: str, data,
+def remote_worker_fit(net, url: str, data,
                       labels=None, *, epochs: int = 1,
                       batch_size: int = 32, seed: int = 0) -> int:
     """One remote worker's training loop against an HTTP parameter
@@ -348,10 +373,16 @@ def remote_worker_fit(net: MultiLayerNetwork, url: str, data,
     pushes on fresh params (the ParameterServerTrainingHook loop a Spark
     executor runs). Returns the number of applied pushes."""
     net._check_init()
-    if any(len(st) for st in net.state_tree):
+    states = (net.state_tree.values()
+              if isinstance(net.state_tree, dict) else net.state_tree)
+    if any(len(st) for st in states):
         raise NotImplementedError(
             "async parameter-server training does not support stateful "
             "layers")
+    if not hasattr(net, "layers"):
+        raise NotImplementedError(
+            "remote_worker_fit drives MultiLayerNetwork; use the "
+            "in-process ParameterServerTrainer for ComputationGraph")
     client = HttpParameterServerClient(url, net.params_tree)
     rng = jax.random.PRNGKey(seed)
 

@@ -90,17 +90,24 @@ class TestParameterServerTrainer:
         assert not srv.push(0, zero_g)  # staleness 2 > 1 -> dropped
         assert srv.version == 2 and srv.stale_drops == 1
 
-    def test_graph_rejected_loudly(self):
+    def test_computation_graph_trains_async(self):
+        """The reference ParameterServerTrainer drives any Model; the
+        graph flavor must converge too."""
         from deeplearning4j_tpu import ComputationGraph
-        conf = (NeuralNetConfiguration.builder().updater(Sgd(0.1))
+        conf = (NeuralNetConfiguration.builder().seed(6).updater(Adam(0.05))
                 .graph_builder().add_inputs("in")
-                .add_layer("out", OutputLayer(n_out=2, activation="softmax",
-                                              loss="mcxent"), "in")
+                .add_layer("d", DenseLayer(n_out=16, activation="relu"),
+                           "in")
+                .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                              loss="mcxent"), "d")
                 .set_outputs("out")
-                .set_input_types(InputType.feed_forward(4)).build())
+                .set_input_types(InputType.feed_forward(2)).build())
         g = ComputationGraph(conf).init()
-        with pytest.raises(NotImplementedError, match="ParallelWrapper"):
-            ParameterServerTrainer(g)
+        x, y = _blobs(n=384, seed=5)
+        tr = ParameterServerTrainer(g, workers=4, max_staleness=4)
+        tr.fit(DataSet(x, y), epochs=10, batch_size=64)
+        assert tr.server.applied == g.iteration > 0
+        assert float((g.predict(x) == y.argmax(1)).mean()) > 0.9
 
 
 def test_stateful_layers_rejected():
