@@ -8,6 +8,8 @@ from .fetchers import (CifarDataSetIterator, CurvesDataSetIterator,
                        MnistDataSetIterator)
 from .images import ImageRecordReader, ImageRecordReaderDataSetIterator
 from .iterators import (AsyncDataSetIterator, AsyncMultiDataSetIterator,
+                        AsyncShieldDataSetIterator,
+                        AsyncShieldMultiDataSetIterator,
                         DataSetIterator, ExistingDataSetIterator,
                         ListDataSetIterator)
 from .normalizers import (ImagePreProcessingScaler, NormalizerMinMaxScaler,

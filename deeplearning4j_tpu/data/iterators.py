@@ -301,3 +301,37 @@ def as_iterator(data, labels=None, batch_size: int = 32) -> DataSetIterator:
         raise ValueError("labels required when passing a raw feature array")
     ds = DataSet(np.asarray(data), np.asarray(labels))
     return ListDataSetIterator(ds, batch_size or ds.num_examples())
+
+
+class AsyncShieldDataSetIterator(DataSetIterator):
+    """Opt-out wrapper: guarantees fit() will NOT wrap the underlying
+    iterator in background prefetch (reference
+    AsyncShieldDataSetIterator — for sources whose batches must not be
+    consumed ahead of the training step, e.g. externally synchronized
+    or stateful readers)."""
+
+    def __init__(self, underlying: DataSetIterator):
+        self.underlying = underlying
+
+    def __iter__(self):
+        self.reset()
+        return self
+
+    def __next__(self) -> DataSet:
+        return self._maybe_preprocess(next(self.underlying))
+
+    def reset(self):
+        self.underlying.reset()
+
+    def batch_size(self):
+        return self.underlying.batch_size()
+
+    def total_examples(self):
+        return self.underlying.total_examples()
+
+    def async_supported(self) -> bool:
+        return False  # the whole point
+
+
+class AsyncShieldMultiDataSetIterator(AsyncShieldDataSetIterator):
+    """Multi-dataset flavor (reference AsyncShieldMultiDataSetIterator)."""

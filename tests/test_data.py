@@ -251,3 +251,30 @@ class TestCurves:
         first = float(net.score_value)
         net.fit(it, epochs=10)
         assert float(net.score_value) < first
+
+
+class TestAsyncShield:
+    def test_shield_prevents_async_wrapping(self):
+        from deeplearning4j_tpu.data.iterators import (
+            AsyncShieldDataSetIterator, ListDataSetIterator)
+        from deeplearning4j_tpu import (Adam, DataSet, DenseLayer,
+                                        InputType, MultiLayerNetwork,
+                                        NeuralNetConfiguration,
+                                        OutputLayer)
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((32, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 32)]
+        inner = ListDataSetIterator(DataSet(x, y), batch_size=8)
+        shield = AsyncShieldDataSetIterator(inner)
+        assert not shield.async_supported()
+        assert shield.batch_size() == 8
+        assert sum(b.features.shape[0] for b in shield) == 32
+        shield.reset()
+        conf = (NeuralNetConfiguration.builder().updater(Adam(0.01)).list()
+                .layer(DenseLayer(n_out=8, activation="tanh"))
+                .layer(OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit(shield, epochs=2)  # fit must take the synchronous path
+        assert net.iteration == 8
