@@ -36,6 +36,8 @@ from .data.dataset import DataSet, MultiDataSet
 from .data.fetchers import (IrisDataSetIterator, MnistDataFetcher,
                             MnistDataSetIterator)
 from .data.iterators import (AsyncDataSetIterator, AsyncMultiDataSetIterator,
+                              AsyncShieldDataSetIterator,
+                              AsyncShieldMultiDataSetIterator,
                              DataSetIterator, ExistingDataSetIterator,
                              ListDataSetIterator)
 from .data.normalizers import (ImagePreProcessingScaler,

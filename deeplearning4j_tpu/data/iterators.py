@@ -310,24 +310,36 @@ class AsyncShieldDataSetIterator(DataSetIterator):
     consumed ahead of the training step, e.g. externally synchronized
     or stateful readers)."""
 
-    def __init__(self, underlying: DataSetIterator):
+    def __init__(self, underlying):
+        # same iterable tolerance as the async wrapper it opts OUT of:
+        # plain lists/generators are accepted (materialized so repeat
+        # epochs see the data)
+        if not hasattr(underlying, "reset"):
+            underlying = list(underlying)
         self.underlying = underlying
+        self._it = None
 
     def __iter__(self):
         self.reset()
         return self
 
     def __next__(self) -> DataSet:
-        return self._maybe_preprocess(next(self.underlying))
+        if self._it is None:
+            self.reset()
+        return self._maybe_preprocess(next(self._it))
 
     def reset(self):
-        self.underlying.reset()
+        if hasattr(self.underlying, "reset"):
+            self.underlying.reset()
+        self._it = iter(self.underlying)
 
     def batch_size(self):
-        return self.underlying.batch_size()
+        return self.underlying.batch_size() \
+            if hasattr(self.underlying, "batch_size") else None
 
     def total_examples(self):
-        return self.underlying.total_examples()
+        return self.underlying.total_examples() \
+            if hasattr(self.underlying, "total_examples") else None
 
     def async_supported(self) -> bool:
         return False  # the whole point

@@ -276,5 +276,29 @@ class TestAsyncShield:
                                    loss="mcxent"))
                 .set_input_type(InputType.feed_forward(4)).build())
         net = MultiLayerNetwork(conf).init()
-        net.fit(shield, epochs=2)  # fit must take the synchronous path
+        # pin the CONTRACT: fit must not construct the async wrapper
+        from deeplearning4j_tpu.data import iterators as it_mod
+        orig = it_mod.AsyncDataSetIterator.__init__
+
+        def boom(self, *a, **k):
+            raise AssertionError("shielded iterator was wrapped async")
+        it_mod.AsyncDataSetIterator.__init__ = boom
+        try:
+            net.fit(shield, epochs=2)
+        finally:
+            it_mod.AsyncDataSetIterator.__init__ = orig
         assert net.iteration == 8
+
+    def test_shield_multi_accepts_plain_iterables(self):
+        from deeplearning4j_tpu.data.iterators import \
+            AsyncShieldMultiDataSetIterator
+        from deeplearning4j_tpu.data.dataset import MultiDataSet
+        rng = np.random.default_rng(1)
+        mk = lambda: MultiDataSet(
+            [rng.standard_normal((4, 3)).astype(np.float32)],
+            [np.eye(2, dtype=np.float32)[rng.integers(0, 2, 4)]])
+        shield = AsyncShieldMultiDataSetIterator([mk(), mk()])
+        assert not shield.async_supported()
+        assert len(list(shield)) == 2
+        shield.reset()
+        assert len(list(shield)) == 2  # re-iterable across epochs
