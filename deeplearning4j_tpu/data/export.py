@@ -95,6 +95,9 @@ class ExportedDataSetIterator(DataSetIterator):
         self._i = 0
 
     def batch_size(self):
+        """NOMINAL batch size (first file's row count). The exporter
+        keeps a smaller final partial batch, so the LAST file may hold
+        fewer rows — don't size fixed buffers off this value."""
         return self._batch
 
     def __next__(self) -> DataSet:

@@ -59,6 +59,10 @@ class CheckpointManager:
     def __init__(self, directory: str, keep: int = 3):
         self.directory = directory
         self.keep = int(keep)
+        if self.keep < 1:
+            # keep=0 would make the retention slice [:-0] == [:0] a
+            # silent no-op (keeps everything); reject instead of surprising
+            raise ValueError("keep must be >= 1, got %d" % self.keep)
         os.makedirs(directory, exist_ok=True)
 
     def _entries(self):

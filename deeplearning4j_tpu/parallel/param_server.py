@@ -108,17 +108,24 @@ class ParameterServer:
     def push(self, version: int, grads) -> bool:
         """Apply a gradient computed at `version`; False = dropped as
         too stale (worker should re-pull and retry on fresh params)."""
+        return self.push_versioned(version, grads)[0]
+
+    def push_versioned(self, version: int, grads):
+        """push() that also returns the post-apply server version,
+        captured under the SAME lock acquisition — reading
+        `server.version` after push() returns can observe a different
+        concurrent push's version."""
         with self._lock:
             if self.version - version > self.max_staleness:
                 self.stale_drops += 1
-                return False
+                return False, self.version
             grads = jax.device_put(grads, self.device)
             self.params, self.opt_state = self._apply(
                 self.params, self.opt_state,
                 jnp.asarray(self.version, jnp.int32), grads)
             self.version += 1
             self.applied += 1
-            return True
+            return True, self.version
 
 
 class ParameterServerTrainer:
@@ -295,9 +302,9 @@ class ParameterServerHttpNode:
         def post_push(payload):
             grads = self._from_npz(
                 self._b64.b64decode(payload["blob"]), server.params)
-            applied = server.push(int(payload["version"]), grads)
-            return 200, {"applied": bool(applied),
-                         "version": server.version}
+            applied, version = server.push_versioned(
+                int(payload["version"]), grads)
+            return 200, {"applied": bool(applied), "version": version}
 
         def get_stats(_):
             return 200, {"version": server.version,
