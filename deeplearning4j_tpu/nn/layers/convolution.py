@@ -313,6 +313,11 @@ class SubsamplingLayer(Layer):
         strides = (1, sh, sw, 1)
         pt = self.pooling_type
         if pt == PoolingType.MAX:
+            # reduce_window + select-and-scatter VJP is the fastest
+            # formulation XLA offers here; both non-overlapping-window
+            # alternatives (reshape-max and strided-slice max) measured
+            # SLOWER end-to-end on VGG16 (178 -> 197 / 243 ms/step,
+            # docs/perf_vgg16.md "attempted, rejected").
             out = lax.reduce_window(x, -jnp.inf, lax.max, window, strides, pads)
         elif pt == PoolingType.SUM:
             out = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
