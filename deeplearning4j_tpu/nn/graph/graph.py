@@ -342,8 +342,12 @@ class ComputationGraph(DeviceIterationMixin):
         group: List[MultiDataSet] = []
 
         def group_sig(m):
-            return (tuple(np.asarray(f).shape for f in m.features),
-                    tuple(np.asarray(l).shape for l in m.labels),
+            # .shape directly — np.asarray on device-resident arrays
+            # would force d2h copies per batch in the hot loop
+            def _shape(a):
+                return a.shape if hasattr(a, "shape") else np.asarray(a).shape
+            return (tuple(_shape(f) for f in m.features),
+                    tuple(_shape(l) for l in m.labels),
                     m.features_masks is None, m.labels_masks is None)
 
         def flush_group():

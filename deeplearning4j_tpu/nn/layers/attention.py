@@ -14,7 +14,8 @@ from dataclasses import dataclass
 
 import jax.numpy as jnp
 
-from ...ops.attention import dense_attention
+from ...ops.attention import (active_sequence_parallel, dense_attention,
+                              ring_self_attention)
 from ...utils import serde
 from .core import Layer, dropout
 
@@ -88,7 +89,19 @@ class SelfAttentionLayer(Layer):
         q = (x @ params[W_Q] + params[B_Q]).reshape(b, t, h, d)
         k = (x @ params[W_K] + params[B_K]).reshape(b, t, h, d)
         v = (x @ params[W_V] + params[B_V]).reshape(b, t, h, d)
-        out = dense_attention(q, k, v, causal=self.causal, key_mask=mask)
+        sp = active_sequence_parallel()
+        if sp is not None and t % int(sp[0].shape[sp[1]]) == 0:
+            # Sequence-parallel training (SequenceParallelWrapper active):
+            # time is sharded over the mesh's seq axis, so attention runs
+            # the ppermute ring instead of materializing [t, t] scores —
+            # gradients flow back through the reversed ring.
+            mesh, seq_axis, batch_axis = sp
+            out = ring_self_attention(q, k, v, mesh, axis=seq_axis,
+                                      causal=self.causal, key_mask=mask,
+                                      batch_axis=batch_axis)
+        else:
+            out = dense_attention(q, k, v, causal=self.causal,
+                                  key_mask=mask)
         out = out.reshape(b, t, self.n_out)
         out = out @ params[W_O] + params[B_O]
         out = self._act()(out)

@@ -189,6 +189,10 @@ class MultiLayerNetwork(DeviceIterationMixin):
         # deep-copied at those seams so donation can never kill a shared
         # buffer.
         self._train_step_fn = jax.jit(train_step, donate_argnums=(0, 1, 2))
+        # Unjitted step: wrappers that must trace under their OWN context
+        # (SequenceParallelWrapper's ring-attention routing) re-jit this
+        # so the net's cached trace is never polluted.
+        self._train_step_raw = train_step
 
         # Fused multi-step training (see ComputationGraph._build_jitted):
         # K optimizer steps per dispatch via lax.scan.
@@ -218,13 +222,12 @@ class MultiLayerNetwork(DeviceIterationMixin):
             multi_step_repeat, donate_argnums=(0, 1, 2),
             static_argnums=(9,))
 
-        def multi_step_repeat_tbptt(params, opt_state, state, iteration,
-                                    rng, x, y, fmask, lmask, length):
-            # One dispatch for `length` full tBPTT batch passes: each
-            # scan step seeds a fresh recurrent carry, unrolls the
-            # window schedule (static from the traced shapes), and
-            # strips the carry — exactly the fit_batch/_fit_tbptt
-            # semantics, minus the per-window dispatch latency.
+        def _tbptt_pass(p, o, s, it, r, x, y, fmask, lmask):
+            """One full tBPTT batch pass: seed a fresh recurrent carry,
+            unroll the window schedule (static from the traced shapes),
+            strip the carry — exactly the fit_batch/_fit_tbptt
+            semantics. Returns (p, o, state_without_carry, it, r, loss
+            of the last window)."""
             T = x.shape[1]
             L = self.conf.tbptt_fwd_length
             batch = x.shape[0]
@@ -238,29 +241,52 @@ class MultiLayerNetwork(DeviceIterationMixin):
 
             def strip(st_tuple):
                 return tuple({k: v for k, v in st.items()
-                              if k not in RECURRENT_CARRY_KEYS} for st in st_tuple)
+                              if k not in RECURRENT_CARRY_KEYS}
+                             for st in st_tuple)
 
+            ms = seed_merge(s)
+            loss = jnp.asarray(0.0, jnp.float32)
+            for start in range(0, T, L):
+                end = min(start + L, T)
+                fm = None if fmask is None else fmask[:, start:end]
+                lm = None if lmask is None else lmask[:, start:end]
+                p, o, ms, it, r, loss = train_step(
+                    p, o, ms, it, r, x[:, start:end],
+                    y[:, start:end], fm, lm)
+            return p, o, strip(ms), it, r, loss
+
+        def multi_step_repeat_tbptt(params, opt_state, state, iteration,
+                                    rng, x, y, fmask, lmask, length):
+            # One dispatch for `length` full tBPTT passes of ONE batch
+            # (closed over — not replicated in HBM).
             def body(carry, _):
-                p, o, s, it, r = carry
-                ms = seed_merge(s)
-                loss = jnp.asarray(0.0, jnp.float32)
-                for start in range(0, T, L):
-                    end = min(start + L, T)
-                    fm = None if fmask is None else fmask[:, start:end]
-                    lm = None if lmask is None else lmask[:, start:end]
-                    p, o, ms, it, r, loss = train_step(
-                        p, o, ms, it, r, x[:, start:end],
-                        y[:, start:end], fm, lm)
-                return (p, o, strip(ms), it, r), loss
+                out = _tbptt_pass(*carry, x, y, fmask, lmask)
+                return out[:5], out[5]
 
             carry, losses = jax.lax.scan(
                 body, (params, opt_state, state, iteration, rng), None,
                 length=length)
             return (*carry, losses)
 
+        def multi_step_stacked_tbptt(params, opt_state, state, iteration,
+                                     rng, s_x, s_y, s_fmask, s_lmask):
+            # One dispatch for K DIFFERENT same-shaped tBPTT batches
+            # (the steps_per_dispatch iterator grouping): each scan step
+            # is one full window schedule on its batch.
+            def body(carry, xs):
+                out = _tbptt_pass(*carry, *xs)
+                return out[:5], out[5]
+
+            carry, losses = jax.lax.scan(
+                body, (params, opt_state, state, iteration, rng),
+                (s_x, s_y, s_fmask, s_lmask))
+            return (*carry, losses)
+
         self._multi_step_repeat_tbptt_fn = jax.jit(
             multi_step_repeat_tbptt, donate_argnums=(0, 1, 2),
             static_argnums=(9,))
+        self._multi_step_stacked_tbptt_fn = jax.jit(
+            multi_step_stacked_tbptt, donate_argnums=(0, 1, 2))
         self._output_fn = jax.jit(
             lambda params, state, x, fmask:
             self._forward_pure(params, state, x, False, None, fmask)[0])
@@ -282,19 +308,19 @@ class MultiLayerNetwork(DeviceIterationMixin):
 
         `steps_per_dispatch > 1` groups that many same-shaped minibatches
         into ONE fused device dispatch (fit_batches' lax.scan —
-        bit-identical math, amortized dispatch latency). Odd-shaped
-        batches (e.g. a short final batch) flush the group and run
-        singly; incompatible with step_fn and truncated BPTT."""
+        bit-identical math, amortized dispatch latency; truncated-BPTT
+        batches fuse their whole window schedules). Odd-shaped batches
+        (e.g. a short final batch) flush the group and run singly;
+        incompatible with step_fn. Listener cadence under tBPTT
+        grouping: one iteration_done per BATCH (iteration advancing by
+        the window count), not one per window — the same coalescing
+        fit_batch_repeated does; per-window listener events require
+        steps_per_dispatch=1."""
         self._check_init()
         spd = int(steps_per_dispatch)
         if spd > 1 and step_fn is not None:
             raise ValueError("steps_per_dispatch cannot combine with a "
                              "custom step_fn")
-        if spd > 1 and self.conf.backprop_type == \
-                BackpropType.TRUNCATED_BPTT:
-            raise NotImplementedError(
-                "steps_per_dispatch > 1 does not support truncated BPTT "
-                "iterators; use fit_batch_repeated for resident batches")
         it = as_iterator(data, labels, batch_size)
         wrapped = AsyncDataSetIterator(it, async_queue_size) \
             if (use_async and it.async_supported()) else it
@@ -302,8 +328,11 @@ class MultiLayerNetwork(DeviceIterationMixin):
         group: List[DataSet] = []
 
         def group_sig(ds):
-            return (np.asarray(ds.features).shape,
-                    np.asarray(ds.labels).shape,
+            # .shape directly — np.asarray on a device-resident array
+            # would force a d2h copy per batch in the hot loop
+            f, l = ds.features, ds.labels
+            return (f.shape if hasattr(f, "shape") else np.asarray(f).shape,
+                    l.shape if hasattr(l, "shape") else np.asarray(l).shape,
                     ds.features_mask is None, ds.labels_mask is None)
 
         def flush_group():
@@ -350,12 +379,11 @@ class MultiLayerNetwork(DeviceIterationMixin):
     def fit_batches(self, batches: Sequence) -> "MultiLayerNetwork":
         """K optimizer steps over K same-shaped DataSets in ONE device
         dispatch (jitted lax.scan; the ComputationGraph.fit_batches
-        analog). Listeners fire per step afterwards."""
+        analog). Listeners fire per step afterwards. Truncated-BPTT
+        batches (rank-3 features AND labels) fuse too: each scan step
+        runs its batch's full window schedule with a fresh carry —
+        scan-vs-loop bit-identical to calling fit per batch."""
         self._check_init()
-        if self.conf.backprop_type == BackpropType.TRUNCATED_BPTT:
-            raise NotImplementedError(
-                "fit_batches does not support truncated BPTT windows; "
-                "call fit in a loop")
         packed = [(self._cast_features(b.features), jnp.asarray(b.labels),
                    None if b.features_mask is None
                    else jnp.asarray(b.features_mask),
@@ -365,6 +393,22 @@ class MultiLayerNetwork(DeviceIterationMixin):
                             else list(batches))]
         stack = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *packed)
         self._rnn_carry = None
+        if self.conf.backprop_type == BackpropType.TRUNCATED_BPTT and \
+                packed[0][0].ndim == 3 and packed[0][1].ndim == 3:
+            T = packed[0][0].shape[1]
+            windows = -(-T // self.conf.tbptt_fwd_length)
+            out = self._multi_step_stacked_tbptt_fn(
+                self.params_tree, self.opt_state, self.state_tree,
+                self._iteration_device(None), self._rng, *stack)
+            self._commit_multi(out, len(packed) * windows,
+                               listener_events=len(packed))
+            return self
+        if self.conf.backprop_type == BackpropType.TRUNCATED_BPTT and \
+                not getattr(self, "_warned_tbptt_labels", False):
+            log.warning(
+                "Truncated BPTT requires rank-3 (time-series) features "
+                "and labels — using standard BPTT")
+            self._warned_tbptt_labels = True
         out = self._multi_step_stacked_fn(
             self.params_tree, self.opt_state, self.state_tree,
             self._iteration_device(None), self._rng, *stack)

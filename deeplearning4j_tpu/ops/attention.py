@@ -17,6 +17,7 @@ to dense softmax attention — tested against `dense_attention` on the
 """
 from __future__ import annotations
 
+import contextlib
 from typing import Optional
 
 import jax
@@ -25,6 +26,36 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 NEG = -1e30  # finite -inf stand-in: keeps exp() NaN-free in masked rows
+
+# --------------------------------------------------------------------------
+# Sequence-parallel context: while active, SelfAttentionLayer routes its
+# attention through ring_self_attention over the given mesh axis instead of
+# dense_attention — the switch that turns the ring kernel from a standalone
+# op into a trainable network path (SequenceParallelWrapper sets it; the
+# context must be active while the train step TRACES, which the wrapper
+# guarantees by holding it across every jitted call).
+# --------------------------------------------------------------------------
+
+_SEQ_PARALLEL: list = []
+
+
+@contextlib.contextmanager
+def sequence_parallel(mesh, axis: str = "seq",
+                      batch_axis: Optional[str] = None):
+    """Route attention layers through the ppermute ring while active.
+    `batch_axis` optionally names a mesh axis the BATCH dim is sharded
+    over (the DP half of a DP x SP mesh)."""
+    _SEQ_PARALLEL.append((mesh, axis, batch_axis))
+    try:
+        yield
+    finally:
+        _SEQ_PARALLEL.pop()
+
+
+def active_sequence_parallel():
+    """(mesh, seq_axis, batch_axis) of the innermost active
+    sequence_parallel context, or None."""
+    return _SEQ_PARALLEL[-1] if _SEQ_PARALLEL else None
 
 
 def dense_attention(q, k, v, *, causal: bool = False,
@@ -115,25 +146,30 @@ def _ring_body(axis: str, n_dev: int, t_loc: int, causal: bool):
 
 def ring_self_attention(q, k, v, mesh, *, axis: str = "seq",
                         causal: bool = False,
-                        key_mask: Optional[jax.Array] = None) -> jax.Array:
+                        key_mask: Optional[jax.Array] = None,
+                        batch_axis: Optional[str] = None) -> jax.Array:
     """Sequence-parallel attention: q/k/v [batch, time, heads, head_dim]
-    with TIME sharded over `axis` of `mesh`. Returns the attention
-    output with the same sharding. See module docstring."""
-    from jax.experimental.shard_map import shard_map
-
+    with TIME sharded over `axis` of `mesh` (and, optionally, BATCH
+    sharded over `batch_axis` — the DP x SP layout; the ring's ppermute
+    then rotates K/V within each data-parallel row of the mesh). Returns
+    the attention output with the same sharding. Fully differentiable:
+    the VJP retraces the ring in reverse (ppermute transposes to the
+    inverse permutation), so this is a trainable path, not just a
+    forward op. See module docstring."""
     n_dev = int(mesh.shape[axis])
     t = q.shape[1]
     if t % n_dev:
         raise ValueError(f"time axis {t} must divide the {n_dev}-device "
                          f"'{axis}' mesh axis")
     body = _ring_body(axis, n_dev, t // n_dev, causal)
-    spec_qkv = P(None, axis, None, None)
+    spec_qkv = P(batch_axis, axis, None, None)
     if key_mask is None:
-        fn = shard_map(lambda a, b, c: body(a, b, c, None), mesh=mesh,
-                       in_specs=(spec_qkv,) * 3, out_specs=spec_qkv,
-                       check_rep=False)
+        fn = jax.shard_map(lambda a, b, c: body(a, b, c, None), mesh=mesh,
+                           in_specs=(spec_qkv,) * 3, out_specs=spec_qkv,
+                           check_vma=False)
         return fn(q, k, v)
-    fn = shard_map(body, mesh=mesh,
-                   in_specs=(spec_qkv, spec_qkv, spec_qkv, P(None, axis)),
-                   out_specs=spec_qkv, check_rep=False)
+    fn = jax.shard_map(body, mesh=mesh,
+                       in_specs=(spec_qkv, spec_qkv, spec_qkv,
+                                 P(batch_axis, axis)),
+                       out_specs=spec_qkv, check_vma=False)
     return fn(q, k, v, key_mask)

@@ -12,4 +12,5 @@ from .mesh import (DATA_AXIS, MODEL_AXIS, SEQ_AXIS, batch_sharded,
 from .param_server import (HttpParameterServerClient, ParameterServer,
                            ParameterServerHttpNode, ParameterServerTrainer,
                            remote_worker_fit)
+from .sequence import SequenceParallelWrapper, seq_parallel_mesh
 from .wrapper import ParallelWrapper

@@ -1,0 +1,188 @@
+"""SequenceParallelWrapper: train attention networks with the TIME axis
+sharded over a device mesh (sequence/context parallelism), optionally
+combined with data parallelism — the trainable face of the ring-attention
+kernel in ops/attention.py.
+
+BEYOND-parity scope (the reference predates attention; its only
+long-sequence devices are truncated BPTT + masking, SURVEY.md §5.7). On
+TPU the canonical long-context mechanism is ring attention over a mesh
+axis: each device holds a time slice of the batch, K/V blocks rotate
+around the ring with `ppermute` over ICI, and nothing ever materializes
+the full [T, T] score matrix. Everything OUTSIDE the attention layers —
+projections, dense layers, the loss — is time-local, so plain GSPMD
+sharding of the [batch, time, ...] tensors handles it: XLA inserts the
+(cheap, loss-reduction) collectives.
+
+Design: this wrapper re-jits the net's raw train step under the
+`sequence_parallel` context, which flips every SelfAttentionLayer from
+`dense_attention` to `ring_self_attention` AT TRACE TIME. The net's own
+cached jit is untouched, so the same network can keep training
+single-device before/after. Gradients flow through the ring (ppermute's
+VJP is the inverse permutation); parity with single-device training is
+pinned by tests/test_sequence_parallel.py.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import mesh as mesh_lib
+from ..ops.attention import sequence_parallel
+
+log = logging.getLogger(__name__)
+
+
+def seq_parallel_mesh(seq_devices: Optional[int] = None,
+                      data_devices: int = 1, devices=None) -> Mesh:
+    """A ("data", "seq") mesh. Default: all devices on the seq axis
+    (pure sequence parallelism); data_devices > 1 gives the DP x SP
+    grid."""
+    devices = list(devices if devices is not None else jax.devices())
+    if seq_devices is None:
+        seq_devices = len(devices) // data_devices
+    return mesh_lib.create_mesh(
+        [data_devices, seq_devices],
+        (mesh_lib.DATA_AXIS, mesh_lib.SEQ_AXIS), devices)
+
+
+class SequenceParallelWrapper:
+    """Train a MultiLayerNetwork containing SelfAttentionLayer(s) with
+    [batch, time] sharded over a ("data", "seq") mesh."""
+
+    def __init__(self, model, mesh: Optional[Mesh] = None):
+        self.model = model
+        self.mesh = mesh if mesh is not None else seq_parallel_mesh()
+        if mesh_lib.SEQ_AXIS not in self.mesh.axis_names:
+            raise ValueError(
+                f"SequenceParallelWrapper needs a mesh with a "
+                f"'{mesh_lib.SEQ_AXIS}' axis; got {self.mesh.axis_names}")
+        self.seq_shards = int(self.mesh.shape[mesh_lib.SEQ_AXIS])
+        self.data_shards = int(self.mesh.shape.get(mesh_lib.DATA_AXIS, 1))
+        self._batch_axis = mesh_lib.DATA_AXIS \
+            if mesh_lib.DATA_AXIS in self.mesh.axis_names \
+            and self.data_shards > 1 else None
+        self._step = None
+        self._out_fn = None
+        self._placed = False
+        self._warned_pad = False
+
+    def _ctx(self):
+        return sequence_parallel(self.mesh, mesh_lib.SEQ_AXIS,
+                                 self._batch_axis)
+
+    def _ensure_step(self):
+        if self._step is None:
+            # Own jit cache: the ring routing is decided when THIS jit
+            # traces (inside _ctx), never touching the net's cached step.
+            self._step = jax.jit(self.model._train_step_raw,
+                                 donate_argnums=(0, 1, 2))
+
+    def _place_model(self):
+        net = self.model
+        net.params_tree = mesh_lib.replicate(self.mesh, net.params_tree)
+        net.opt_state = mesh_lib.replicate(self.mesh, net.opt_state)
+        net.state_tree = mesh_lib.replicate(self.mesh, net.state_tree)
+        net._rng = mesh_lib.replicate(self.mesh, net._rng)
+        self._placed = True
+
+    def _shard_bt(self, a, time_sharded: bool, cast_dtype=None):
+        """Place [batch, time, ...] (or [batch, ...]) arrays: batch over
+        "data" (if the mesh has a >1 data axis), time over "seq"."""
+        if a is None:
+            return None
+        a = jnp.asarray(a)
+        if cast_dtype is not None and jnp.issubdtype(a.dtype, jnp.floating):
+            a = a.astype(cast_dtype)
+        axes = [self._batch_axis]
+        if time_sharded and a.ndim >= 2:
+            axes.append(mesh_lib.SEQ_AXIS)
+        spec = P(*axes) if len(axes) > 1 else P(axes[0])
+        return jax.device_put(a, NamedSharding(self.mesh, spec))
+
+    # ------------------------------------------------------------------- fit
+    def fit(self, data, labels=None, *, epochs: int = 1,
+            batch_size: int = 128) -> "SequenceParallelWrapper":
+        self.model._check_init()
+        self.model.fit(data, labels, epochs=epochs, batch_size=batch_size,
+                       step_fn=self.fit_batch)
+        return self
+
+    def fit_batch(self, ds) -> None:
+        """One globally-synchronous step with batch x time sharded.
+        Exactly the net's math: the only difference from single-device
+        training is WHERE each time slice lives (+ f32 reassociation in
+        the ring's online softmax)."""
+        net = self.model
+        net._check_init()
+        if not self._placed:
+            self._place_model()
+        self._ensure_step()
+        x = jnp.asarray(ds.features)
+        t = x.shape[1]
+        if t % self.seq_shards:
+            raise ValueError(
+                f"time axis {t} must divide the {self.seq_shards}-way seq "
+                f"axis")
+        y = jnp.asarray(ds.labels)
+        fmask = ds.features_mask
+        lmask = ds.labels_mask
+        pad = (-x.shape[0]) % self.data_shards
+        if pad:
+            # Short final batch (iterator tail): pad by repeating the
+            # last example with ZERO label-mask weight — loss and
+            # gradients match the unpadded batch exactly (the
+            # ParallelWrapper._pad_lmask contract; attention is
+            # per-example, so pad rows cannot leak into real rows).
+            if not self._warned_pad:
+                log.warning(
+                    "Batch size %d not divisible by %d data shards; "
+                    "padding with zero-loss-weight copies of the tail "
+                    "example", x.shape[0], self.data_shards)
+                self._warned_pad = True
+            rep = lambda a: None if a is None else jnp.concatenate(
+                [jnp.asarray(a),
+                 jnp.broadcast_to(jnp.asarray(a)[-1:],
+                                  (pad,) + jnp.asarray(a).shape[1:])], 0)
+            if lmask is None:
+                lmask = jnp.ones(y.shape[:2] if y.ndim >= 3
+                                 else (y.shape[0], 1), jnp.float32)
+            x, y, fmask, lmask = rep(x), rep(y), rep(fmask), rep(lmask)
+            lmask = lmask.at[-pad:].set(0.0)
+        xs = self._shard_bt(x, True, cast_dtype=net._dtype)
+        ys = self._shard_bt(y, y.ndim >= 3)
+        fm = self._shard_bt(fmask, True)
+        # a [batch, 1] per-example weight mask has no time axis to shard
+        lm = self._shard_bt(lmask, lmask is not None and
+                            jnp.asarray(lmask).ndim >= 2 and
+                            jnp.asarray(lmask).shape[1] == t)
+        orig = net._train_step_fn
+        net._train_step_fn = self._step
+        try:
+            # context held across the CALL so the first call's trace (and
+            # any retrace) sees it
+            with self._ctx():
+                net._run_and_commit(xs, ys, fm, lm, mesh=self.mesh)
+        finally:
+            net._train_step_fn = orig
+
+    def output(self, x, features_mask=None):
+        """Sequence-parallel inference through the same ring path (own
+        jit so the net's cached forward stays dense)."""
+        net = self.model
+        net._check_init()
+        if not self._placed:
+            self._place_model()
+        if self._out_fn is None:
+            self._out_fn = jax.jit(
+                lambda params, state, xx, fm:
+                net._forward_pure(params, state, xx, False, None, fm)[0])
+        xs = self._shard_bt(x, True, cast_dtype=net._dtype)
+        fm = self._shard_bt(features_mask, True)
+        with self._ctx(), self.mesh:
+            out = self._out_fn(net.params_tree, net.state_tree, xs, fm)
+        return np.asarray(out)

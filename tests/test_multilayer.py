@@ -337,6 +337,38 @@ class TestStepsPerDispatch:
                         jax.tree_util.tree_leaves(n2.params_tree)):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
+    def test_fit_grouped_tbptt_matches_plain(self):
+        """Iterator-fed truncated-BPTT fit with steps_per_dispatch > 1
+        (the r3 VERDICT item: fused dispatch was fit_batch_repeated-only
+        for RNNs) == the per-batch loop, param for param."""
+        from deeplearning4j_tpu import GravesLSTM, RnnOutputLayer
+        from deeplearning4j_tpu.nn.conf.builders import BackpropType
+        conf = lambda: (NeuralNetConfiguration.builder().seed(5)
+                        .updater(Adam(0.01)).list()
+                        .layer(GravesLSTM(n_out=8, activation="tanh"))
+                        .layer(RnnOutputLayer(n_out=3, activation="softmax",
+                                              loss="mcxent"))
+                        .set_input_type(InputType.recurrent(4))
+                        .backprop_type(BackpropType.TRUNCATED_BPTT)
+                        .tbptt_fwd_length(5).tbptt_back_length(5)
+                        .build())
+        rng = np.random.default_rng(1)
+        # 40 rows at batch 16 -> 2 full batches + one short; T=12 ->
+        # 3 windows per batch
+        x = rng.standard_normal((40, 12, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (40, 12))]
+        n1 = MultiLayerNetwork(conf()).init()
+        n1.fit(x, y, epochs=2, batch_size=16, use_async=False)
+        n2 = MultiLayerNetwork(conf()).init()
+        n2.fit(x, y, epochs=2, batch_size=16, use_async=False,
+               steps_per_dispatch=2)
+        # 2 epochs x 3 batches x 3 windows = 18 optimizer steps
+        assert n1.iteration == n2.iteration == 18
+        for a, b in zip(jax.tree_util.tree_leaves(n1.params_tree),
+                        jax.tree_util.tree_leaves(n2.params_tree)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-7)
+
     def test_incompatible_combinations_raise(self):
         conf = (NeuralNetConfiguration.builder().updater(Adam(0.01)).list()
                 .layer(OutputLayer(n_out=2, activation="softmax",
