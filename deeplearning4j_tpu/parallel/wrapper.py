@@ -286,19 +286,31 @@ class ParallelWrapper:
         def avg(t):  # averageAndPropagate: mean over replicas, re-broadcast
             return tmap(avg_one, t)
 
+        def _avg_keep(st):
+            return {k: (v if k in RECURRENT_CARRY_KEYS else avg_one(v))
+                    for k, v in st.items()}
+
         def avg_keep_carry(t):
             # tBPTT variant: params/opt/BN-stats average, but each
             # replica's recurrent carry (h/c) belongs to ITS data shard
-            # and must never be averaged across replicas
+            # and must never be averaged across replicas. State is a
+            # tuple of dicts for MultiLayerNetwork, a dict of dicts for
+            # ComputationGraph.
             params, opt, state = t
-            state = tuple(
-                {k: (v if k in RECURRENT_CARRY_KEYS else avg_one(v))
-                 for k, v in st.items()} for st in state)
+            if isinstance(state, dict):
+                state = {name: _avg_keep(st) for name, st in state.items()}
+            else:
+                state = tuple(_avg_keep(st) for st in state)
             return tmap(avg_one, params), tmap(avg_one, opt), state
 
+        def _strip(st):
+            return {k: v for k, v in st.items()
+                    if k not in RECURRENT_CARRY_KEYS}
+
         def strip_carry(state):
-            return tuple({k: v for k, v in st.items()
-                          if k not in RECURRENT_CARRY_KEYS} for st in state)
+            if isinstance(state, dict):
+                return {name: _strip(st) for name, st in state.items()}
+            return tuple(_strip(st) for st in state)
 
         def take0(t):  # replicas are equal post-average; unstack view
             return tmap(lambda a: a[0], t)
@@ -379,27 +391,33 @@ class ParallelWrapper:
         net._check_init()
         if hasattr(net, "_pack"):  # ComputationGraph
             from ..nn.conf.builders import BackpropType
+            mds = net._coerce(ds)
             if net.conf.backprop_type == BackpropType.TRUNCATED_BPTT:
-                # _local_round_tbptt implements the windowed carry for
-                # MultiLayerNetwork only; a silent whole-sequence step
-                # here would diverge from single-device training
-                raise NotImplementedError(
-                    "ComputationGraph truncated BPTT with "
-                    "averaging_frequency > 1 is not supported; use "
-                    "averaging_frequency=1 (synchronous DP)")
+                # np.ndim reads metadata — no d2h copy of device batches
+                if any(np.ndim(f) == 3 for f in mds.features) and \
+                        all(np.ndim(l) == 3 for l in mds.labels):
+                    self._local_round_tbptt_graph(mds)
+                    return
+                # mirror the single-device warn-once fallback
+                # (graph.py fit_batch): rank-2 labels run standard BPTT
+                if not getattr(net, "_warned_tbptt_labels", False):
+                    log.warning(
+                        "Truncated BPTT requires rank-3 features and "
+                        "labels; using standard BPTT")
+                    net._warned_tbptt_labels = True
             inputs, labels, fm, lm, n = self._prep_graph_batch(ds)
             data = tuple({k: self._stack_data(v, n) for k, v in d.items()}
                          for d in (inputs, labels, fm, lm))
         else:
             from ..nn.conf.builders import BackpropType
             if net.conf.backprop_type == BackpropType.TRUNCATED_BPTT and \
-                    np.asarray(ds.features).ndim == 3 and \
-                    np.asarray(ds.labels).ndim == 3:
+                    np.ndim(ds.features) == 3 and \
+                    np.ndim(ds.labels) == 3:
                 self._local_round_tbptt(ds)
                 return
             x, y = ds.features, ds.labels
             fmask, lmask = ds.features_mask, ds.labels_mask
-            n = np.asarray(x).shape[0]
+            n = np.shape(x)[0]
             if self.multiprocess:
                 self._check_local_divisible(n)
             elif n % self.data_shards != 0:
@@ -491,6 +509,73 @@ class ParallelWrapper:
                 lst.iteration_done(net, net.iteration)
         # batch over: drop the carry (net + next batch reseeds the stack)
         net.rnn_clear_previous_state()
+        params, opt, state = self._stacked
+        with self.mesh:
+            self._stacked = (params, opt,
+                             self._jit_helpers["strip_carry"](state))
+
+    def _local_round_tbptt_graph(self, mds) -> None:
+        """Local SGD over a truncated-BPTT batch for ComputationGraph —
+        the _local_round_tbptt analog (reference behavior: Spark workers
+        train tBPTT graphs between averages,
+        ParameterAveragingTrainingMaster.java:346-357). Every replica
+        runs the SAME window schedule on its shard with the recurrent
+        carry riding the replica-stacked state; one optimizer step per
+        window per replica; params/opt/non-carry state average every F
+        windows. Window slicing mirrors ComputationGraph._fit_tbptt
+        (rank-2 static inputs pass whole into every window)."""
+        net = self.model
+        n = np.shape(mds.features[0])[0]
+        if self.multiprocess:
+            self._check_local_divisible(n)
+        elif n % self.data_shards != 0:
+            raise ValueError(
+                f"truncated-BPTT batch size {n} must divide the "
+                f"{self.data_shards}-way data mesh")
+        chunk = (n // self.local_shards if self.multiprocess
+                 else n // self.data_shards)
+        # Seed a CHUNK-sized carry and stack it per replica before
+        # handing control to the graph's own window loop (each replica's
+        # carry covers its shard of the batch).
+        net.rnn_clear_previous_state()
+        net._seed_recurrent_states(chunk)
+        self._ensure_stacked(4)
+        params, opt, _ = self._stacked
+        with self.mesh:
+            state = self._jit_helpers["stack"](net._merged_state())
+        self._stacked = (params, opt, state)
+        net.rnn_clear_previous_state()
+
+        def window_step(inputs, labels, fm, lm):
+            # one stacked local step for this window across all replicas
+            data = tuple({k: self._stack_data(v, n) for k, v in d.items()}
+                         for d in (inputs, labels, fm, lm))
+            params, opt, state = self._stacked
+            with self.mesh:
+                (params, opt, state, _, self._stacked_rngs,
+                 losses) = self._stacked_step(
+                    params, opt, state,
+                    jnp.asarray(net.iteration, jnp.int32),
+                    self._stacked_rngs, *data)
+            self._stacked = (params, opt, state)
+            self._since_avg += 1
+            net.iteration += 1
+            net.score_value = jnp.mean(losses)
+            if self._since_avg >= self.averaging_frequency:
+                self._stacked = self._jit_helpers["avg_keep_carry"](
+                    self._stacked)
+                self._since_avg = 0
+            self._sync_net_from_stacked()
+            for lst in net.listeners:
+                lst.iteration_done(net, net.iteration)
+
+        # Reuse the graph's OWN window slicing (_fit_tbptt's documented
+        # do_step contract) so the schedule can never drift from the
+        # single-device path. Its batch-sized net-carry seeding is
+        # irrelevant here (window_step reads only the stacked state) and
+        # it clears the net carry when the batch ends.
+        net._fit_tbptt(mds, do_step=window_step)
+        # batch over: drop the carry (next batch reseeds the stack)
         params, opt, state = self._stacked
         with self.mesh:
             self._stacked = (params, opt,

@@ -417,11 +417,56 @@ class TestDPxRecurrent:
         with pytest.raises(ValueError, match="must divide"):
             pw.fit_batch(ds)
 
-    def test_graph_tbptt_local_sgd_rejected_loudly(self):
+    def test_graph_tbptt_local_sgd_matches_manual_replicas(self):
+        """ComputationGraph tBPTT under averaging_frequency > 1 (the
+        round-3 NotImplementedError site, now implemented): every
+        replica runs the same window schedule on its shard, carry stays
+        per-replica, params/opt average every F windows — verified
+        against a manual W-replica simulation (reference behavior:
+        Spark workers train tBPTT graphs between averages,
+        ParameterAveragingTrainingMaster.java:346-357)."""
         from deeplearning4j_tpu.data.dataset import MultiDataSet
-        g = self._graph_rnn(seed=13)
-        pw = ParallelWrapper(g, mesh=data_parallel_mesh(4),
-                             averaging_frequency=2)
+        W, F = 4, 2
         ds = self._rnn_data(seed=4)
-        with pytest.raises(NotImplementedError, match="averaging_freq"):
-            pw.fit_batch(MultiDataSet([ds.features], [ds.labels]))
+        mds = MultiDataSet([ds.features], [ds.labels])
+
+        nets = [self._graph_rnn(seed=13) for _ in range(W)]
+        chunk = self.BATCH // W
+        shards = [MultiDataSet([ds.features[i*chunk:(i+1)*chunk]],
+                               [ds.labels[i*chunk:(i+1)*chunk]])
+                  for i in range(W)]
+        tmap = jax.tree_util.tree_map
+        steps = 0
+        T, L = self.SEQ, 5
+        for _ in range(2):  # 2 batches
+            for net in nets:
+                net.rnn_clear_previous_state()
+                net._seed_recurrent_states(chunk)
+            for start in range(0, T, L):
+                end = min(start + L, T)
+                for net, shard in zip(nets, shards):
+                    win = MultiDataSet([shard.features[0][:, start:end]],
+                                       [shard.labels[0][:, start:end]])
+                    net._run_and_commit(*net._pack(win))
+                steps += 1
+                if steps % F == 0:
+                    avg_p = tmap(lambda *xs: np.mean(np.stack(xs), 0),
+                                 *[n.params_tree for n in nets])
+                    avg_o = tmap(lambda *xs: np.mean(np.stack(xs), 0),
+                                 *[n.opt_state for n in nets])
+                    for net in nets:
+                        net.params_tree = tmap(jax.numpy.asarray, avg_p)
+                        net.opt_state = tmap(jax.numpy.asarray, avg_o)
+            for net in nets:
+                net.rnn_clear_previous_state()
+
+        local = self._graph_rnn(seed=13)
+        pw = ParallelWrapper(local, mesh=data_parallel_mesh(W),
+                             averaging_frequency=F)
+        for _ in range(2):
+            pw.fit_batch(mds)
+        assert local.iteration == steps
+        for a, b in zip(jax.tree_util.tree_leaves(nets[0].params_tree),
+                        jax.tree_util.tree_leaves(local.params_tree)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-4, atol=2e-5)
