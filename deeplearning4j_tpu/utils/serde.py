@@ -61,7 +61,10 @@ def to_dict(obj: Any) -> Any:
     if isinstance(obj, enum.Enum):
         return {"@enum": type(obj).__serde_name__, "value": obj.name}
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
-        name = getattr(obj, "__serde_name__", None)
+        # vars(), not getattr: a subclass INHERITS its parent's
+        # __serde_name__, and serializing it under the parent's tag would
+        # silently reconstruct the wrong class (dropping subclass fields)
+        name = vars(type(obj)).get("__serde_name__")
         if name is None:
             raise TypeError(
                 f"{type(obj).__name__} is a dataclass but not @serde.register'd"
