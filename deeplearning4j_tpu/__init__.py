@@ -27,7 +27,7 @@ from .nn.layers.recurrent import (LSTM, GravesBidirectionalLSTM, GravesLSTM,
 from .nn.multilayer import MultiLayerNetwork
 from .nn.graph import (ComputationGraph, ElementWiseVertex, L2NormalizeVertex,
                        L2Vertex, LastTimeStepVertex, MergeVertex,
-                       PreprocessorVertex, ReshapeVertex, ScaleVertex,
+                       PoolHelperVertex, PreprocessorVertex, ReshapeVertex, ScaleVertex,
                        ShiftVertex, StackVertex, SubsetVertex, UnstackVertex)
 from .nn.updaters import (Adam, AdaDelta, AdaGrad, AdaMax, GradientNormalization,
                           Nesterovs, NoOp, RmsProp, Sgd)
@@ -54,6 +54,7 @@ from .optimize.listeners import (CheckpointListener,
                                  CollectScoresIterationListener,
                                  ComposableIterationListener,
                                  EvaluativeListener, IterationListener,
+                                 ParamAndGradientIterationListener,
                                  PerformanceListener, ScoreIterationListener)
 from .utils.model_serializer import restore_model, save_model
 

@@ -1,5 +1,10 @@
 """Observability: StatsListener → StatsStorage → static report + live UIServer
 (reference deeplearning4j-ui-parent, SURVEY.md §2.6/§5.5)."""
+from .components import (ChartHistogram, ChartHorizontalBar, ChartLine,
+                         ChartScatter, ComponentDiv, ComponentTable,
+                         ComponentText, component_from_json,
+                         component_to_json, render_component)
+from .convolutional import ConvolutionalIterationListener
 from .remote import RemoteStatsStorageRouter, StatsReceiverServer
 from .report import export_json, render_html, render_html_report
 from .server import UIServer

@@ -22,6 +22,7 @@ Routes:
 from __future__ import annotations
 
 import threading
+import zlib
 from typing import Optional, Sequence
 
 import numpy as np
@@ -46,7 +47,10 @@ def _scatter_svg(points: np.ndarray, labels: Sequence[str],
     for l in labels:
         if l not in uniq:
             uniq.append(l)
-    color = {l: f"hsl({(hash(str(l)) % 360)},65%,45%)" for l in uniq}
+    # crc32, not hash(): Python hash() is salted per process, which would
+    # recolor every label on restart / across workers sharing one view
+    color = {l: f"hsl({(zlib.crc32(str(l).encode()) % 360)},65%,45%)"
+             for l in uniq}
     dots = "".join(
         f'<circle cx="{pad + x * (width - 2 * pad):.1f}" '
         f'cy="{height - pad - y * (height - 2 * pad):.1f}" r="3" '
@@ -75,12 +79,14 @@ class UIServer:
         self.refresh_seconds = float(refresh_seconds)
         self._embedding = None  # (points [n,2], labels [n])
         self._model = None   # network shown on /model (flow module)
+        self._activations = None  # ([(name, png_bytes)...], iteration)
         self._server = JsonHttpServer(
             get_routes={"/train/sessions": self._sessions,
                         "/train/data": self._data},
             post_routes={"/tsne/upload": self._tsne_upload},
             raw_get_routes={"/": self._index, "/tsne": self._tsne_page,
-                            "/model": self._model_page},
+                            "/model": self._model_page,
+                            "/activations": self._activations_page},
             port=port)
 
     # ----------------------------------------------------------- lifecycle
@@ -223,6 +229,41 @@ class UIServer:
                f'<path d="M0,0 L6,3 L0,6 z" fill="#668"/></marker></defs>'
                f'{"".join(edges)}{"".join(boxes)}</svg></body></html>')
         return 200, "text/html; charset=utf-8", doc.encode()
+
+    # ------------------------------------------------- convolutional module
+    def attach_activations(self, grids, iteration: int) -> "UIServer":
+        """Show per-conv-layer activation grids on /activations (the
+        reference play `convolutional` module; fed by
+        ui.convolutional.ConvolutionalIterationListener). `grids`:
+        [(layer_name, png_bytes), ...]."""
+        with self._lock:
+            self._activations = (list(grids), int(iteration))
+        return self
+
+    def _activations_page(self):
+        import base64
+        import html as _html
+        with self._lock:
+            snap = self._activations
+        if snap is None:
+            return (200, "text/html; charset=utf-8",
+                    b"<!doctype html><meta http-equiv='refresh' "
+                    b"content='2'><body>no activations yet - add a "
+                    b"ConvolutionalIterationListener</body>")
+        grids, iteration = snap
+        parts = [f"<!doctype html><html><head><meta charset='utf-8'>"
+                 f"<meta http-equiv='refresh' "
+                 f"content='{self.refresh_seconds}'>"
+                 f"<title>Activations</title></head><body>"
+                 f"<h1>Conv activations @ iteration {iteration}</h1>"]
+        for name, png in grids:
+            b64 = base64.b64encode(png).decode()
+            parts.append(
+                f"<h3>{_html.escape(str(name))}</h3>"
+                f'<img style="image-rendering:pixelated" width="512" '
+                f'src="data:image/png;base64,{b64}"/>')
+        parts.append("</body></html>")
+        return 200, "text/html; charset=utf-8", "".join(parts).encode()
 
     # --------------------------------------------------------- tsne module
     def attach_embedding(self, points, labels=None) -> "UIServer":

@@ -123,6 +123,31 @@ class TestListeners:
         assert len(collect.scores) == 6
         assert collect.scores[0][0] == 1
 
+    def test_param_and_gradient_listener(self, tmp_path):
+        """reference ParamAndGradientIterationListener.java:30 role:
+        per-iteration magnitude rows, header + one line per iteration,
+        to printer AND file; update columns appear from iteration 2."""
+        import os
+        from deeplearning4j_tpu import ParamAndGradientIterationListener
+        net = _net()
+        msgs = []
+        path = os.path.join(tmp_path, "pg.tsv")
+        net.set_listeners(ParamAndGradientIterationListener(
+            frequency=1, printer=msgs.append, file_path=path))
+        net.fit(_data(), epochs=2, batch_size=16)
+        # header + 6 iterations
+        assert len(msgs) == 7
+        header = msgs[0].split("\t")
+        assert header[0] == "iteration" and header[1] == "score"
+        assert any(c.endswith(".p.absmean") for c in header)
+        row2 = msgs[2].split("\t")  # iteration 2: real update stats
+        assert len(row2) == len(header)
+        assert any(c.endswith(".u.absmean") for c in header)
+        with open(path) as f:
+            assert len(f.read().strip().splitlines()) == 7
+        # magnitudes are finite numbers
+        assert all(np.isfinite(float(v)) for v in row2)
+
     def test_performance_listener(self):
         net = _net()
         msgs = []

@@ -1,0 +1,127 @@
+"""Round-4 UI modules: convolutional-activations view (reference
+ConvolutionalIterationListener.java:38 + the play `convolutional`
+module) and ui-components (reference ui/api/Component.java JSON object
+model)."""
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (Adam, DataSet, InputType, MultiLayerNetwork,
+                                NeuralNetConfiguration, OutputLayer)
+from deeplearning4j_tpu.nn.layers.convolution import (ConvolutionLayer,
+                                                      SubsamplingLayer)
+from deeplearning4j_tpu.ui import (ChartHistogram, ChartHorizontalBar,
+                                   ChartLine, ChartScatter, ComponentDiv,
+                                   ComponentTable, ComponentText,
+                                   ConvolutionalIterationListener,
+                                   component_from_json, component_to_json,
+                                   render_component)
+from deeplearning4j_tpu.ui.convolutional import activation_grid, png_gray
+from deeplearning4j_tpu.ui.server import UIServer
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.read()
+
+
+def _cnn():
+    conf = (NeuralNetConfiguration.builder().seed(5).updater(Adam(0.01))
+            .list()
+            .layer(ConvolutionLayer(kernel_size=(3, 3), stride=(1, 1),
+                                    padding=(1, 1), n_out=6,
+                                    activation="relu"))
+            .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.convolutional(8, 8, 1))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+class TestPngAndGrid:
+    def test_png_decodes(self):
+        """The stdlib encoder emits a real PNG (magic + chunk layout)."""
+        img = (np.arange(64, dtype=np.uint8).reshape(8, 8) * 3)
+        png = png_gray(img)
+        assert png.startswith(b"\x89PNG\r\n\x1a\n")
+        assert b"IHDR" in png and b"IDAT" in png and png.endswith(
+            b"\x00\x00\x00\x00IEND\xaeB`\x82"[-8:])
+
+    def test_grid_tiles_channels(self):
+        act = np.zeros((4, 4, 5), np.float32)
+        act[:, :, 2] = 7.0  # constant channel: normalizes to 0, no NaN
+        grid = activation_grid(act, border=1)
+        # 5 channels -> 3 cols x 2 rows of 4x4 tiles + borders
+        assert grid.shape == (2 * 5 + 1, 3 * 5 + 1)
+        assert np.isfinite(grid.astype(np.float64)).all()
+
+    def test_grid_rejects_bad_rank(self):
+        with pytest.raises(ValueError, match="H, W, C"):
+            activation_grid(np.zeros((3, 3)))
+
+
+class TestConvolutionalModule:
+    def test_listener_publishes_grids_to_server(self):
+        net = _cnn()
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((16, 8, 8, 1)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+        server = UIServer(port=0).start()
+        try:
+            net.set_listeners(ConvolutionalIterationListener(
+                probe=x[0], frequency=2, ui=server))
+            # no activations yet
+            assert b"no activations" in _get(server.url + "/activations")
+            for _ in range(4):
+                net._fit_batch(DataSet(x, y))
+            page = _get(server.url + "/activations")
+            assert b"iteration 4" in page
+            # one grid per SPATIAL activation: conv + subsampling
+            assert page.count(b"data:image/png;base64,") == 2
+            assert b"ConvolutionLayer" in page
+        finally:
+            server.stop()
+
+
+class TestUiComponents:
+    def _tree(self):
+        return ComponentDiv(
+            style="width:600px",
+            components=[
+                ComponentText(text="Training report", font_size=16),
+                ComponentTable(header=["metric", "value"],
+                               content=[["loss", "0.31"],
+                                        ["accuracy", "0.94"]]),
+                ChartLine(title="score", series_names=["train"],
+                          x=[[0.0, 1.0, 2.0]], y=[[1.0, 0.6, 0.3]]),
+                ChartScatter(title="emb", series_names=["a"],
+                             x=[[0.0, 1.0]], y=[[1.0, 0.0]]),
+                ChartHistogram.from_values(
+                    np.random.default_rng(0).standard_normal(200),
+                    bins=10, title="weights"),
+                ChartHorizontalBar(labels=["l1", "l2"],
+                                   values=[0.5, 0.9], title="norms"),
+            ])
+
+    def test_json_roundtrip(self):
+        """The Component.java contract: the JSON is the wire format and
+        reconstructs the exact component tree."""
+        tree = self._tree()
+        js = component_to_json(tree)
+        back = component_from_json(js)
+        assert back == tree
+        assert isinstance(back.components[2], ChartLine)
+
+    def test_render_html(self):
+        doc = render_component(self._tree())
+        assert doc.startswith("<!doctype html>")
+        assert "Training report" in doc
+        assert doc.count("<svg") == 4  # one per chart
+        assert "<table" in doc and "accuracy" in doc
+
+    def test_histogram_from_values_bins(self):
+        h = ChartHistogram.from_values([0.0, 0.5, 1.0, 1.5], bins=3)
+        assert len(h.lower) == len(h.upper) == len(h.y) == 3
+        assert sum(h.y) == 4.0
