@@ -2,6 +2,6 @@
 from .graph import ComputationGraph
 from .vertices import (DuplicateToTimeSeriesVertex, ElementWiseVertex,
                        GraphVertex, L2NormalizeVertex, L2Vertex,
-                       LastTimeStepVertex, MergeVertex, PreprocessorVertex,
-                       ReshapeVertex, ScaleVertex, ShiftVertex, StackVertex,
+                       LastTimeStepVertex, MergeVertex, PoolHelperVertex,
+                       PreprocessorVertex, ReshapeVertex, ScaleVertex, ShiftVertex, StackVertex,
                        SubsetVertex, UnstackVertex)

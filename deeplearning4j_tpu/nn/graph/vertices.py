@@ -207,6 +207,30 @@ class ShiftVertex(GraphVertex):
 
 @serde.register
 @dataclass
+class PoolHelperVertex(GraphVertex):
+    """Strip the first spatial row + column (reference
+    nn/conf/graph/PoolHelperVertex.java:33 +
+    nn/graph/vertex/impl/PoolHelperVertex.java:66-80): compensates for
+    Caffe's ceil-mode pooling producing one extra leading row/col when
+    importing GoogLeNet-style models. Reference crops NCHW dims 2,3;
+    NHWC here, so the crop is [:, 1:, 1:, :]."""
+
+    def forward(self, inputs, *, train=False, rng=None, masks=None):
+        if len(inputs) != 1:
+            raise ValueError("PoolHelperVertex requires a single input")
+        return inputs[0][:, 1:, 1:, :]
+
+    def output_type(self, input_types):
+        t = input_types[0]
+        if not isinstance(t, ConvolutionalType):
+            raise ValueError(
+                f"PoolHelperVertex needs CNN input, got {t}")
+        return ConvolutionalType(height=t.height - 1, width=t.width - 1,
+                                 channels=t.channels)
+
+
+@serde.register
+@dataclass
 class ReshapeVertex(GraphVertex):
     """Reshape to [batch, *new_shape] (reference ReshapeVertex)."""
 

@@ -1,0 +1,40 @@
+"""Worker process for the cross-process pub/sub streaming test.
+
+    python stream_worker.py <url> <in_topic> <out_topic> <n>
+
+Plays the remote Kafka-consumer/producer role
+(reference dl4j-streaming NDArrayKafkaClient.java:10): long-polls
+`in_topic` over the HTTP stream transport, doubles each array, and
+publishes the result to `out_topic`. Exits after `n` arrays.
+No deeplearning4j_tpu import — this process proves the wire protocol
+alone is enough for a foreign client."""
+import json
+import sys
+import urllib.request
+
+url, t_in, t_out, n = (sys.argv[1], sys.argv[2], sys.argv[3],
+                       int(sys.argv[4]))
+
+
+def post(path, obj):
+    req = urllib.request.Request(url + path,
+                                 data=json.dumps(obj).encode())
+    return json.loads(urllib.request.urlopen(req, timeout=30).read())
+
+
+# register the subscription BEFORE signalling readiness so the parent's
+# first publish can never race past an unsubscribed topic
+post("/consume", {"topic": t_in, "timeout": 0.05, "client": "worker"})
+print("READY", flush=True)
+
+done = 0
+while done < n:
+    got = post("/consume", {"topic": t_in, "timeout": 10,
+                            "client": "worker"})
+    if got.get("empty"):
+        continue
+    doubled = [2.0 * v for v in got["data"]]
+    post("/publish", {"topic": t_out, "shape": got["shape"],
+                      "data": doubled})
+    done += 1
+print("DONE", done, flush=True)

@@ -165,6 +165,45 @@ class TestVertices:
         assert acts["stack"].shape == (8, 2)
         np.testing.assert_allclose(np.asarray(acts["u0"]), x)
 
+    def test_pool_helper_vertex(self):
+        """PoolHelperVertex strips the first spatial row+column
+        (reference nn/conf/graph/PoolHelperVertex.java:33, the
+        Caffe-ceil-pooling import fix; NCHW dims 2,3 there -> NHWC
+        [:, 1:, 1:, :] here), passes gradients through untouched, and
+        trains in-graph."""
+        from deeplearning4j_tpu import PoolHelperVertex
+        from deeplearning4j_tpu.nn.layers.convolution import ConvolutionLayer
+        rng = np.random.default_rng(2)
+        x = rng.standard_normal((4, 5, 5, 2)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 4)]
+        conf = (NeuralNetConfiguration.builder().seed(3).updater(Sgd(0.1))
+                .graph_builder()
+                .add_inputs("in")
+                .add_vertex("crop", PoolHelperVertex(), "in")
+                .add_layer("conv", ConvolutionLayer(
+                    kernel_size=(2, 2), stride=(2, 2), n_out=3,
+                    activation="relu"), "crop")
+                .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                              loss="mcxent"), "conv")
+                .set_outputs("out")
+                .set_input_types(InputType.convolutional(5, 5, 2))
+                .build())
+        g = ComputationGraph(conf).init()
+        acts, _, _, _ = g._walk(g.params_tree, g.state_tree,
+                                {"in": jnp.asarray(x)}, False, None, {})
+        np.testing.assert_allclose(np.asarray(acts["crop"]),
+                                   x[:, 1:, 1:, :])
+        s0 = None
+        for i in range(5):
+            g.fit_batch(MultiDataSet([x], [y]))
+            if i == 0:
+                s0 = float(g.score_value)
+        assert float(g.score_value) < s0
+        # serde round-trip keeps the vertex
+        from deeplearning4j_tpu.utils import serde
+        back = serde.from_json(serde.to_json(conf))
+        assert isinstance(back.nodes["crop"].vertex, PoolHelperVertex)
+
     def test_last_time_step_masked(self):
         rng = np.random.default_rng(0)
         x = rng.standard_normal((3, 5, 4)).astype(np.float32)
