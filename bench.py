@@ -202,6 +202,79 @@ def bench_alexnet(batch=256, steps=10, repeats=3, use_pallas=True):
     return (batch * steps) / dt
 
 
+def bench_googlenet(batch=256, steps=10, repeats=3):
+    """zoo GoogLeNet (inception v1) training img/s/chip — the
+    ComputationGraph inception-merge + LRN workload (reference
+    zoo/model/GoogLeNet.java:83-180). bf16, fused multi-step loop."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.models import GoogLeNet
+    from deeplearning4j_tpu.data.dataset import MultiDataSet
+
+    g = GoogLeNet(num_labels=1000).init(dtype=jnp.bfloat16)
+    rng = np.random.default_rng(0)
+    x = jax.device_put(jnp.asarray(
+        rng.standard_normal((batch, 224, 224, 3)), jnp.bfloat16))
+    y = jax.device_put(
+        np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, batch)])
+    mds = MultiDataSet([x], [y])
+    g.fit_batch_repeated(mds, steps)
+    float(g.score_value)  # fence (compile + warm)
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        g.fit_batch_repeated(mds, steps)
+        float(g.score_value)
+        times.append(time.perf_counter() - t0)
+    dt = sorted(times)[len(times) // 2]
+    return (batch * steps) / dt
+
+
+def bench_attention(batch=64, seq_len=512, width=256, heads=8, steps=10,
+                    repeats=3):
+    """Self-attention char-model training tokens/sec (BEYOND-parity
+    workload — the reference predates attention, SURVEY.md §5.7): two
+    causal multi-head SelfAttention layers + RnnOutput, bf16, fused
+    multi-step loop. The long-context companion row to `lstm`."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                    NeuralNetConfiguration, RnnOutputLayer,
+                                    Sgd)
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer
+
+    vocab = 96
+    conf = (NeuralNetConfiguration.builder().seed(0)
+            .updater(Sgd(0.1)).list()
+            .layer(SelfAttentionLayer(n_out=width, n_heads=heads,
+                                      causal=True, activation="relu"))
+            .layer(SelfAttentionLayer(n_out=width, n_heads=heads,
+                                      causal=True, activation="relu"))
+            .layer(RnnOutputLayer(n_out=vocab, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(InputType.recurrent(vocab))
+            .build())
+    net = MultiLayerNetwork(conf).init(dtype=jnp.bfloat16)
+    rng = np.random.default_rng(0)
+    idx = rng.integers(0, vocab, (batch, seq_len))
+    x = jax.device_put(jnp.asarray(
+        np.eye(vocab, dtype=np.float32)[idx], jnp.bfloat16))
+    y = jax.device_put(jnp.asarray(
+        np.eye(vocab, dtype=np.float32)[np.roll(idx, -1, 1)]))
+    ds = DataSet(x, y)
+    net.fit_batch_repeated(ds, steps)
+    float(net.score_value)  # fence (compile + warm)
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        net.fit_batch_repeated(ds, steps)
+        float(net.score_value)
+        times.append(time.perf_counter() - t0)
+    dt = sorted(times)[len(times) // 2]
+    return (batch * seq_len * steps) / dt
+
+
 def bench_lstm(batch=128, seq_len=64, steps=30, repeats=3):
     """GravesLSTM char-RNN tokens/sec (zoo TextGenerationLSTM workload;
     reference zoo/model/TextGenerationLSTM.java)."""
@@ -399,6 +472,15 @@ def main():
         metric = "vgg16_imagenet_bf16_images_per_sec_per_chip"
         flops = ips * VGG16_TRAIN_FLOPS_PER_IMAGE
         extra = {"est_mfu": round(flops / TPU_V5E_BF16_PEAK, 3)}
+    elif workload == "attention":
+        ips = bench_attention()
+        metric = "selfattention_charmodel_tokens_per_sec"
+        unit = "tokens/sec"
+        extra = {}
+    elif workload == "googlenet":
+        ips = bench_googlenet()
+        metric = "googlenet_imagenet_bf16_images_per_sec_per_chip"
+        extra = {}
     elif workload == "alexnet":
         ips = bench_alexnet(use_pallas=True)
         metric = "alexnet_imagenet_images_per_sec_per_chip"
@@ -423,7 +505,7 @@ def main():
         extra = {"est_mfu": round(flops / TPU_V5E_BF16_PEAK, 3)}
     else:
         raise SystemExit(
-            f"Unknown workload {workload!r}; use resnet50 [batch] | vgg16 "
+            f"Unknown workload {workload!r}; use resnet50 [batch] | vgg16 | googlenet | attention "
             "| alexnet | alexnet_laxlrn | lenet | lstm | w2v [scale] | etl "
             "| lenet_hostfed")
     print(json.dumps({

@@ -205,6 +205,9 @@ class ComputationGraph(DeviceIterationMixin):
 
         # Donate params/opt/state (see MultiLayerNetwork._build_jitted).
         self._train_step_fn = jax.jit(train_step, donate_argnums=(0, 1, 2))
+        # Unjitted step for wrappers that trace under their own context
+        # (SequenceParallelWrapper) without polluting this cache.
+        self._train_step_raw = train_step
 
         # Fused multi-step training: K optimizer steps per device dispatch
         # via lax.scan — the MaxText-style jitted training loop. Amortizes

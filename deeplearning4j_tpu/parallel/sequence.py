@@ -108,6 +108,24 @@ class SequenceParallelWrapper:
     def fit(self, data, labels=None, *, epochs: int = 1,
             batch_size: int = 128) -> "SequenceParallelWrapper":
         self.model._check_init()
+        if hasattr(self.model, "_pack"):
+            # Graph batches are not padded (multi-head masks make
+            # zero-weight padding head-specific), so reject an
+            # indivisible tail batch UP FRONT instead of aborting
+            # mid-epoch with params already mutated.
+            try:
+                mds = self.model._coerce(data)
+                n = np.shape(mds.features[0])[0]
+            except Exception:
+                n = None  # iterator input: checked per batch
+            if n is not None:
+                tail = n % batch_size
+                if tail and tail % self.data_shards:
+                    raise ValueError(
+                        f"final batch of {tail} examples does not divide "
+                        f"the {self.data_shards}-way data axis; choose a "
+                        f"batch size so every batch (incl. the tail) is "
+                        f"divisible, or repartition")
         self.model.fit(data, labels, epochs=epochs, batch_size=batch_size,
                        step_fn=self.fit_batch)
         return self
@@ -116,12 +134,16 @@ class SequenceParallelWrapper:
         """One globally-synchronous step with batch x time sharded.
         Exactly the net's math: the only difference from single-device
         training is WHERE each time slice lives (+ f32 reassociation in
-        the ring's online softmax)."""
+        the ring's online softmax). Accepts a DataSet for
+        MultiLayerNetwork or a (Multi)DataSet for ComputationGraph."""
         net = self.model
         net._check_init()
         if not self._placed:
             self._place_model()
         self._ensure_step()
+        if hasattr(net, "_pack"):  # ComputationGraph
+            self._fit_batch_graph(ds)
+            return
         x = jnp.asarray(ds.features)
         t = x.shape[1]
         if t % self.seq_shards:
@@ -170,11 +192,63 @@ class SequenceParallelWrapper:
         finally:
             net._train_step_fn = orig
 
+    def _fit_batch_graph(self, ds) -> None:
+        """ComputationGraph step: every rank-3 dict entry gets
+        [batch, time] sharded; rank-2 entries (static inputs,
+        per-example masks) shard batch only. Batch must divide the data
+        axis (the graph's multi-head masks make zero-weight padding
+        head-specific; repartition instead)."""
+        net = self.model
+        inputs, labels, fm, lm = net._pack(net._coerce(ds))
+        n = next(iter(inputs.values())).shape[0]
+        if n % self.data_shards:
+            raise ValueError(
+                f"batch {n} must divide the {self.data_shards}-way data "
+                f"axis (no padding for graph batches)")
+        t_axes = {a.shape[1] for a in inputs.values()
+                  if hasattr(a, "ndim") and a.ndim == 3}
+        for t in t_axes:
+            if t % self.seq_shards:
+                raise ValueError(
+                    f"time axis {t} must divide the {self.seq_shards}-way "
+                    f"seq axis")
+
+        def shard_dict(d, cast=None, is_mask=False):
+            # rank-3 tensors carry [batch, time, features]; rank-2 MASK
+            # entries carry [batch, time]. A rank-2 non-mask array whose
+            # second dim merely EQUALS a sequence length is a feature
+            # axis coincidence and must shard batch-only.
+            def tsh(v):
+                if v is None:
+                    return False
+                if np.ndim(v) == 3:
+                    return np.shape(v)[1] in t_axes
+                return is_mask and np.ndim(v) == 2 and \
+                    np.shape(v)[1] in t_axes
+            return {k: self._shard_bt(v, tsh(v), cast_dtype=cast)
+                    for k, v in d.items()}
+
+        packed = (shard_dict(inputs, cast=net._dtype), shard_dict(labels),
+                  shard_dict(fm, is_mask=True),
+                  shard_dict(lm, is_mask=True))
+        orig = net._train_step_fn
+        net._train_step_fn = self._step
+        try:
+            with self._ctx():
+                net._run_and_commit(*packed, mesh=self.mesh)
+        finally:
+            net._train_step_fn = orig
+
     def output(self, x, features_mask=None):
         """Sequence-parallel inference through the same ring path (own
-        jit so the net's cached forward stays dense)."""
+        jit so the net's cached forward stays dense).
+        MultiLayerNetwork only — use net.outputs() for graphs."""
         net = self.model
         net._check_init()
+        if hasattr(net, "_pack"):  # ComputationGraph has no _forward_pure
+            raise NotImplementedError(
+                "sequence-parallel output() supports MultiLayerNetwork "
+                "only; run ComputationGraph inference via net.outputs()")
         if not self._placed:
             self._place_model()
         if self._out_fn is None:

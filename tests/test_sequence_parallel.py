@@ -139,6 +139,51 @@ class TestSequenceParallelTraining:
         assert net.iteration == 2  # one batch per epoch
 
 
+class TestSequenceParallelGraph:
+    def _gconf(self, seed=9):
+        from deeplearning4j_tpu import ComputationGraph
+        conf = (NeuralNetConfiguration.builder().seed(seed)
+                .updater(Sgd(0.1))
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("att", SelfAttentionLayer(n_out=16, n_heads=4,
+                                                     causal=True), "in")
+                .add_layer("out", RnnOutputLayer(n_out=3,
+                                                 activation="softmax",
+                                                 loss="mcxent"), "att")
+                .set_outputs("out")
+                .set_input_types(InputType.recurrent(8))
+                .build())
+        return ComputationGraph(conf).init()
+
+    def test_graph_fit_matches_single_device(self):
+        """ComputationGraph attention nets train sequence-parallel too:
+        2 steps on the DP x SP mesh == 2 single-device steps."""
+        from deeplearning4j_tpu.data.dataset import MultiDataSet
+        x, y = _data(seed=11)
+        mds = MultiDataSet([x], [y])
+        single = self._gconf()
+        sharded = self._gconf()
+        w = SequenceParallelWrapper(sharded,
+                                    seq_parallel_mesh(data_devices=2))
+        for _ in range(2):
+            single.fit_batch(mds)
+            w.fit_batch(mds)
+        sp = jax.tree_util.tree_leaves(single.params_tree)
+        wp = jax.tree_util.tree_leaves(sharded.params_tree)
+        for a, b in zip(sp, wp):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-5)
+
+    def test_graph_indivisible_batch_rejected(self):
+        from deeplearning4j_tpu.data.dataset import MultiDataSet
+        x, y = _data(n=7)
+        g = self._gconf()
+        w = SequenceParallelWrapper(g, seq_parallel_mesh(data_devices=2))
+        with pytest.raises(ValueError, match="divide"):
+            w.fit_batch(MultiDataSet([x], [y]))
+
+
 class TestSequenceParallelContext:
     def test_context_nesting(self):
         mesh = seq_parallel_mesh()

@@ -1,0 +1,52 @@
+"""Zoo parameter-layout stability (SURVEY §7(g): the checkpoint
+param-ordering compatibility question, r3 VERDICT weak item 7): every
+zoo model's parameter tree — node order and per-node parameter names —
+must match the committed manifest, so checkpoints written by any past
+version keep loading after refactors. Regenerate the fixture ONLY for a
+deliberate, documented format break
+(tests/fixtures/zoo_param_manifest.json; see
+tests/test_serialization_regression.py for the value-level twin)."""
+import json
+import os
+
+import pytest
+
+from deeplearning4j_tpu.models import (AlexNet, GoogLeNet, LeNet, ResNet50,
+                                       SimpleCNN, TextGenerationLSTM, VGG16,
+                                       VGG19)
+
+MANIFEST = os.path.join(os.path.dirname(__file__), "fixtures",
+                        "zoo_param_manifest.json")
+
+SMALL = dict(num_labels=10, input_shape=(32, 32, 3))
+GRAPH = dict(num_labels=10, input_shape=(64, 64, 3))
+
+CASES = [
+    ("LeNet", lambda: LeNet(**SMALL)),
+    ("SimpleCNN", lambda: SimpleCNN(**SMALL)),
+    ("AlexNet", lambda: AlexNet(**SMALL)),
+    ("VGG16", lambda: VGG16(**SMALL)),
+    ("VGG19", lambda: VGG19(**SMALL)),
+    ("TextGenerationLSTM", lambda: TextGenerationLSTM()),
+    ("ResNet50", lambda: ResNet50(**GRAPH)),
+    ("GoogLeNet", lambda: GoogLeNet(**GRAPH)),
+]
+
+
+@pytest.mark.parametrize("name,build", CASES, ids=[c[0] for c in CASES])
+def test_param_layout_matches_manifest(name, build):
+    with open(MANIFEST) as f:
+        manifest = json.load(f)
+    net = build().init()
+    tree = net.params_tree
+    if isinstance(tree, dict):
+        keys = [[n, sorted(p.keys())] for n, p in tree.items()]
+    else:
+        keys = [[i, sorted(p.keys())] for i, p in enumerate(tree)]
+    expect = [[k if isinstance(k, str) else int(k), v]
+              for k, v in manifest[name]]
+    got = [[k if isinstance(k, str) else int(k), v] for k, v in keys]
+    assert got == expect, (
+        f"{name} parameter layout changed — existing checkpoints will "
+        f"not restore. If deliberate, regenerate the manifest and add a "
+        f"migration note.")
