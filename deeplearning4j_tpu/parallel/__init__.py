@@ -13,4 +13,5 @@ from .param_server import (HttpParameterServerClient, ParameterServer,
                            ParameterServerHttpNode, ParameterServerTrainer,
                            remote_worker_fit)
 from .sequence import SequenceParallelWrapper, seq_parallel_mesh
+from .tensor import TensorParallelWrapper, tensor_parallel_mesh
 from .wrapper import ParallelWrapper
