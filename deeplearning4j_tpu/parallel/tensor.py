@@ -50,9 +50,9 @@ def tensor_parallel_mesh(model_devices: Optional[int] = None,
 
 
 class TensorParallelWrapper:
-    """Drop-in TP/DP x TP trainer for MultiLayerNetwork (ComputationGraph
-    is not yet supported — its packed-dict step needs its own sharding
-    plumbing; use ParallelWrapper for graphs meanwhile)."""
+    """Drop-in TP/DP x TP trainer for MultiLayerNetwork and
+    ComputationGraph (conv kernels [kh, kw, in, out] shard out-channels;
+    XLA partitions the convolutions the same way it does matmuls)."""
 
     def __init__(self, model, mesh: Optional[Mesh] = None):
         self.model = model
@@ -127,8 +127,10 @@ class TensorParallelWrapper:
             # Reject an indivisible tail batch UP FRONT, not mid-epoch
             # with params already mutated.
             try:
-                n = np.shape(data.features if hasattr(data, "features")
-                             else data)[0]
+                feats = data.features if hasattr(data, "features") else data
+                if isinstance(feats, (list, tuple)):  # MultiDataSet
+                    feats = feats[0]
+                n = np.shape(feats)[0]
             except Exception:
                 n = None  # iterator input: checked per batch
             if n is not None:
@@ -145,39 +147,63 @@ class TensorParallelWrapper:
 
     def fit_batch(self, ds) -> None:
         """One globally-synchronous step: batch sharded over "data",
-        params over "model"; XLA partitions the matmuls and inserts the
-        activation collectives. Delegates to the net's own _fit_batch so
-        recurrent-carry reset and tBPTT windowing can never diverge from
-        the single-device path (the ParallelWrapper do_step contract)."""
+        params over "model"; XLA partitions the matmuls/convs and
+        inserts the activation collectives. Delegates to the net's own
+        batch dispatch so recurrent-carry reset and tBPTT windowing can
+        never diverge from the single-device path (the ParallelWrapper
+        do_step contract)."""
         net = self.model
         net._check_init()
-        if hasattr(net, "_pack"):
-            raise NotImplementedError(
-                "TensorParallelWrapper supports MultiLayerNetwork only; "
-                "use ParallelWrapper for ComputationGraph")
         if not self._placed:
             self._place_model()
         self._ensure_step()
+        if hasattr(net, "_pack"):  # ComputationGraph
+            net.fit_batch(net._coerce(ds), do_step=self._tp_graph_step)
+            return
         net._fit_batch(ds, do_step=self._tp_step)
+
+    def _put_batch(self, a, cast=None):
+        """Place one batch-leading array: batch over "data" (floating
+        inputs cast to the net dtype); shared by the MLN and graph
+        steps so the placement rule can never diverge between them."""
+        if a is None:
+            return None
+        a = jnp.asarray(a)
+        if cast is not None and jnp.issubdtype(a.dtype, jnp.floating):
+            a = a.astype(cast)
+        return mesh_lib.place(
+            a, NamedSharding(self.mesh, P(self._batch_axis)), self.mesh)
+
+    def _run_sharded(self, *packed) -> None:
+        """Swap in the TP step for one commit (restored afterwards)."""
+        net = self.model
+        orig = net._train_step_fn
+        net._train_step_fn = self._step
+        try:
+            net._run_and_commit(*packed, mesh=self.mesh)
+        finally:
+            net._train_step_fn = orig
+
+    def _tp_graph_step(self, inputs, labels, fm, lm) -> None:
+        net = self.model
+        n = next(iter(inputs.values())).shape[0]
+        if n % self.data_shards:
+            raise ValueError(
+                f"batch {n} must divide the {self.data_shards}-way data "
+                f"axis")
+        shard = lambda d, cast=None: {k: self._put_batch(v, cast)
+                                      for k, v in d.items()}
+        self._run_sharded(shard(inputs, cast=net._dtype), shard(labels),
+                          shard(fm), shard(lm))
 
     def _tp_step(self, x, y, fmask, lmask) -> None:
         if np.shape(x)[0] % self.data_shards:
             raise ValueError(
                 f"batch {np.shape(x)[0]} must divide the "
                 f"{self.data_shards}-way data axis")
-        net = self.model
-        bsh = NamedSharding(self.mesh, P(self._batch_axis))
-        put = lambda a, cast=None: None if a is None else mesh_lib.place(
-            jnp.asarray(a).astype(cast) if cast is not None and
-            jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)
-            else jnp.asarray(a), bsh, self.mesh)
-        orig = net._train_step_fn
-        net._train_step_fn = self._step
-        try:
-            net._run_and_commit(put(x, cast=net._dtype), put(y),
-                                put(fmask), put(lmask), mesh=self.mesh)
-        finally:
-            net._train_step_fn = orig
+        self._run_sharded(self._put_batch(x, cast=self.model._dtype),
+                          self._put_batch(y), self._put_batch(fmask),
+                          self._put_batch(lmask))
 
     def param_shard_report(self) -> dict:
         """{param_path: partition spec} for every sharded (non-replicated)

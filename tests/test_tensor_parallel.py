@@ -153,10 +153,51 @@ class TestTensorParallel:
         assert single.iteration == tp_net.iteration == 6
         _assert_params_close(single.params_tree, tp_net.params_tree)
 
-    def test_graph_rejected_loudly(self):
+    def test_graph_conv_fit_matches(self):
+        """ComputationGraph under TP: conv kernels shard out-channels
+        over the model axis; the partitioned convolutions match
+        single-device training."""
         from deeplearning4j_tpu import ComputationGraph
         from deeplearning4j_tpu.data.dataset import MultiDataSet
-        conf = (NeuralNetConfiguration.builder().seed(1).updater(Sgd(0.1))
+        from deeplearning4j_tpu.nn.layers.convolution import ConvolutionLayer
+        conf = lambda: (NeuralNetConfiguration.builder().seed(13)
+                        .updater(Sgd(0.1))
+                        .graph_builder()
+                        .add_inputs("in")
+                        .add_layer("c1", ConvolutionLayer(
+                            kernel_size=(3, 3), stride=(1, 1),
+                            padding=(1, 1), n_out=16, activation="relu"),
+                            "in")
+                        .add_layer("c2", ConvolutionLayer(
+                            kernel_size=(3, 3), stride=(2, 2), n_out=8,
+                            activation="relu"), "c1")
+                        .add_layer("out", OutputLayer(
+                            n_out=3, activation="softmax", loss="mcxent"),
+                            "c2")
+                        .set_outputs("out")
+                        .set_input_types(InputType.convolutional(8, 8, 2))
+                        .build())
+        rng = np.random.default_rng(8)
+        x = rng.standard_normal((4, 8, 8, 2)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 4)]
+        single = ComputationGraph(conf()).init()
+        tp_g = ComputationGraph(conf()).init()
+        w = TensorParallelWrapper(tp_g, tensor_parallel_mesh())
+        mds = MultiDataSet([x], [y])
+        for _ in range(2):
+            single.fit_batch(mds)
+            w.fit_batch(mds)
+        report = w.param_shard_report()
+        assert report["c1.W"] == (None, None, None, "model")
+        _assert_params_close(single.params_tree, tp_g.params_tree)
+
+    def test_graph_fit_epoch_loop_with_dp(self):
+        """fit() drives a graph under DP x TP: the tail-batch pre-check
+        reads the true row count of a MultiDataSet (not the number of
+        input arrays — the r4 review repro)."""
+        from deeplearning4j_tpu import ComputationGraph
+        from deeplearning4j_tpu.data.dataset import MultiDataSet
+        conf = (NeuralNetConfiguration.builder().seed(2).updater(Sgd(0.1))
                 .graph_builder()
                 .add_inputs("in")
                 .add_layer("out", OutputLayer(n_out=2, activation="softmax",
@@ -164,10 +205,12 @@ class TestTensorParallel:
                 .set_outputs("out")
                 .build())
         g = ComputationGraph(conf).init()
-        w = TensorParallelWrapper(g, tensor_parallel_mesh())
-        with pytest.raises(NotImplementedError, match="MultiLayerNetwork"):
-            w.fit_batch(MultiDataSet([np.zeros((4, 4), np.float32)],
-                                     [np.eye(2, dtype=np.float32)[[0, 1, 0, 1]]]))
+        w = TensorParallelWrapper(g, tensor_parallel_mesh(data_devices=2))
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((8, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 8)]
+        w.fit(MultiDataSet([x], [y]), epochs=2, batch_size=8)
+        assert g.epoch == 2
 
     def test_indivisible_batch_rejected(self):
         x, y = _ff_data(n=5)
