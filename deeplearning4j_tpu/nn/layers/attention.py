@@ -94,11 +94,28 @@ class SelfAttentionLayer(Layer):
             # Sequence-parallel training (SequenceParallelWrapper active):
             # time is sharded over the mesh's seq axis, so attention runs
             # the ppermute ring instead of materializing [t, t] scores —
-            # gradients flow back through the reversed ring.
-            mesh, seq_axis, batch_axis = sp
+            # gradients flow back through the reversed ring. A head axis
+            # (tensor parallelism) composes per-head.
+            mesh, seq_axis, batch_axis, head_axis = sp
+            if head_axis is not None and \
+                    h % int(mesh.shape[head_axis]) != 0:
+                # indivisible heads: replicate them (params may still be
+                # sharded, so q/k/v all-gather before the ring) — warn
+                # once so the inactive head-parallelism is visible
+                if not getattr(SelfAttentionLayer,
+                               "_warned_head_fallback", False):
+                    import logging
+                    logging.getLogger(__name__).warning(
+                        "n_heads=%d does not divide the %d-way '%s' "
+                        "mesh axis; attention heads replicate (tensor "
+                        "parallelism inactive for the ring)",
+                        h, int(mesh.shape[head_axis]), head_axis)
+                    SelfAttentionLayer._warned_head_fallback = True
+                head_axis = None
             out = ring_self_attention(q, k, v, mesh, axis=seq_axis,
                                       causal=self.causal, key_mask=mask,
-                                      batch_axis=batch_axis)
+                                      batch_axis=batch_axis,
+                                      head_axis=head_axis)
         else:
             out = dense_attention(q, k, v, causal=self.causal,
                                   key_mask=mask)

@@ -41,11 +41,15 @@ _SEQ_PARALLEL: list = []
 
 @contextlib.contextmanager
 def sequence_parallel(mesh, axis: str = "seq",
-                      batch_axis: Optional[str] = None):
+                      batch_axis: Optional[str] = None,
+                      head_axis: Optional[str] = None):
     """Route attention layers through the ppermute ring while active.
     `batch_axis` optionally names a mesh axis the BATCH dim is sharded
-    over (the DP half of a DP x SP mesh)."""
-    _SEQ_PARALLEL.append((mesh, axis, batch_axis))
+    over (the DP half of a DP x SP mesh); `head_axis` optionally names
+    one the HEAD dim is sharded over (tensor parallelism — attention is
+    per-head independent, so head sharding composes with the ring for
+    free)."""
+    _SEQ_PARALLEL.append((mesh, axis, batch_axis, head_axis))
     try:
         yield
     finally:
@@ -53,7 +57,7 @@ def sequence_parallel(mesh, axis: str = "seq",
 
 
 def active_sequence_parallel():
-    """(mesh, seq_axis, batch_axis) of the innermost active
+    """(mesh, seq_axis, batch_axis, head_axis) of the innermost active
     sequence_parallel context, or None."""
     return _SEQ_PARALLEL[-1] if _SEQ_PARALLEL else None
 
@@ -147,22 +151,28 @@ def _ring_body(axis: str, n_dev: int, t_loc: int, causal: bool):
 def ring_self_attention(q, k, v, mesh, *, axis: str = "seq",
                         causal: bool = False,
                         key_mask: Optional[jax.Array] = None,
-                        batch_axis: Optional[str] = None) -> jax.Array:
+                        batch_axis: Optional[str] = None,
+                        head_axis: Optional[str] = None) -> jax.Array:
     """Sequence-parallel attention: q/k/v [batch, time, heads, head_dim]
     with TIME sharded over `axis` of `mesh` (and, optionally, BATCH
-    sharded over `batch_axis` — the DP x SP layout; the ring's ppermute
-    then rotates K/V within each data-parallel row of the mesh). Returns
-    the attention output with the same sharding. Fully differentiable:
-    the VJP retraces the ring in reverse (ppermute transposes to the
-    inverse permutation), so this is a trainable path, not just a
-    forward op. See module docstring."""
+    sharded over `batch_axis` — the DP x SP layout — and HEADS over
+    `head_axis` — the TP third dimension; heads are independent, so the
+    ring body is unchanged and each device simply holds its head slice).
+    Returns the attention output with the same sharding. Fully
+    differentiable: the VJP retraces the ring in reverse (ppermute
+    transposes to the inverse permutation), so this is a trainable path,
+    not just a forward op. See module docstring."""
     n_dev = int(mesh.shape[axis])
     t = q.shape[1]
     if t % n_dev:
         raise ValueError(f"time axis {t} must divide the {n_dev}-device "
                          f"'{axis}' mesh axis")
+    if head_axis is not None and q.shape[2] % int(mesh.shape[head_axis]):
+        raise ValueError(
+            f"heads {q.shape[2]} must divide the "
+            f"{int(mesh.shape[head_axis])}-device '{head_axis}' mesh axis")
     body = _ring_body(axis, n_dev, t // n_dev, causal)
-    spec_qkv = P(batch_axis, axis, None, None)
+    spec_qkv = P(batch_axis, axis, head_axis, None)
     if key_mask is None:
         fn = jax.shard_map(lambda a, b, c: body(a, b, c, None), mesh=mesh,
                            in_specs=(spec_qkv,) * 3, out_specs=spec_qkv,

@@ -38,13 +38,19 @@ log = logging.getLogger(__name__)
 
 
 def seq_parallel_mesh(seq_devices: Optional[int] = None,
-                      data_devices: int = 1, devices=None) -> Mesh:
-    """A ("data", "seq") mesh. Default: all devices on the seq axis
-    (pure sequence parallelism); data_devices > 1 gives the DP x SP
-    grid."""
+                      data_devices: int = 1, model_devices: int = 1,
+                      devices=None) -> Mesh:
+    """A ("data", "seq") mesh — or ("data", "model", "seq") when
+    model_devices > 1 (the 3-D DP x TP x SP grid). Default: all devices
+    on the seq axis (pure sequence parallelism)."""
     devices = list(devices if devices is not None else jax.devices())
     if seq_devices is None:
-        seq_devices = len(devices) // data_devices
+        seq_devices = len(devices) // (data_devices * model_devices)
+    if model_devices > 1:
+        return mesh_lib.create_mesh(
+            [data_devices, model_devices, seq_devices],
+            (mesh_lib.DATA_AXIS, mesh_lib.MODEL_AXIS, mesh_lib.SEQ_AXIS),
+            devices)
     return mesh_lib.create_mesh(
         [data_devices, seq_devices],
         (mesh_lib.DATA_AXIS, mesh_lib.SEQ_AXIS), devices)
@@ -52,7 +58,10 @@ def seq_parallel_mesh(seq_devices: Optional[int] = None,
 
 class SequenceParallelWrapper:
     """Train a MultiLayerNetwork containing SelfAttentionLayer(s) with
-    [batch, time] sharded over a ("data", "seq") mesh."""
+    [batch, time] sharded over a ("data", "seq") mesh. If the mesh ALSO
+    carries a >1 "model" axis, parameters shard over it (the
+    TensorParallelWrapper rule) and the ring shards attention HEADS over
+    it too — full 3-D DP x TP x SP training from one wrapper."""
 
     def __init__(self, model, mesh: Optional[Mesh] = None):
         self.model = model
@@ -63,9 +72,13 @@ class SequenceParallelWrapper:
                 f"'{mesh_lib.SEQ_AXIS}' axis; got {self.mesh.axis_names}")
         self.seq_shards = int(self.mesh.shape[mesh_lib.SEQ_AXIS])
         self.data_shards = int(self.mesh.shape.get(mesh_lib.DATA_AXIS, 1))
+        self.model_shards = int(self.mesh.shape.get(mesh_lib.MODEL_AXIS, 1))
         self._batch_axis = mesh_lib.DATA_AXIS \
             if mesh_lib.DATA_AXIS in self.mesh.axis_names \
             and self.data_shards > 1 else None
+        self._head_axis = mesh_lib.MODEL_AXIS \
+            if mesh_lib.MODEL_AXIS in self.mesh.axis_names \
+            and self.model_shards > 1 else None
         self._step = None
         self._out_fn = None
         self._placed = False
@@ -73,21 +86,33 @@ class SequenceParallelWrapper:
 
     def _ctx(self):
         return sequence_parallel(self.mesh, mesh_lib.SEQ_AXIS,
-                                 self._batch_axis)
+                                 self._batch_axis, self._head_axis)
 
     def _ensure_step(self):
         if self._step is None:
             # Own jit cache: the ring routing is decided when THIS jit
             # traces (inside _ctx), never touching the net's cached step.
-            self._step = jax.jit(self.model._train_step_raw,
-                                 donate_argnums=(0, 1, 2))
+            if self._head_axis is not None:
+                # 3-D mode: reuse the tensor-parallel pinned-step helper
+                # (params/opt layouts pinned, state unconstrained — see
+                # jit_tp_step for why)
+                from .tensor import jit_tp_step
+                self._step = jit_tp_step(self.model)
+            else:
+                self._step = jax.jit(self.model._train_step_raw,
+                                     donate_argnums=(0, 1, 2))
 
     def _place_model(self):
         net = self.model
-        net.params_tree = mesh_lib.replicate(self.mesh, net.params_tree)
-        net.opt_state = mesh_lib.replicate(self.mesh, net.opt_state)
-        net.state_tree = mesh_lib.replicate(self.mesh, net.state_tree)
-        net._rng = mesh_lib.replicate(self.mesh, net._rng)
+        if self._head_axis is not None:
+            # 3-D mode: the shared tensor-parallel placement policy
+            from .tensor import place_model_tp
+            place_model_tp(net, self.mesh, self.model_shards)
+        else:
+            net.params_tree = mesh_lib.replicate(self.mesh, net.params_tree)
+            net.opt_state = mesh_lib.replicate(self.mesh, net.opt_state)
+            net.state_tree = mesh_lib.replicate(self.mesh, net.state_tree)
+            net._rng = mesh_lib.replicate(self.mesh, net._rng)
         self._placed = True
 
     def _shard_bt(self, a, time_sharded: bool, cast_dtype=None):

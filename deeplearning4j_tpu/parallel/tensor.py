@@ -49,6 +49,58 @@ def tensor_parallel_mesh(model_devices: Optional[int] = None,
         (mesh_lib.DATA_AXIS, mesh_lib.MODEL_AXIS), devices)
 
 
+def model_param_spec(arr, model_shards: int) -> P:
+    """The tensor-parallel sharding rule: shard the LAST dim divisible
+    by the model-axis size over "model"; replicate otherwise (shared by
+    TensorParallelWrapper and SequenceParallelWrapper's 3-D mode)."""
+    shape = np.shape(arr)
+    if len(shape) == 0 or not jnp.issubdtype(
+            jnp.asarray(arr).dtype, jnp.floating):
+        return P()
+    for dim in range(len(shape) - 1, -1, -1):
+        if shape[dim] >= model_shards and shape[dim] % model_shards == 0:
+            spec = [None] * len(shape)
+            spec[dim] = mesh_lib.MODEL_AXIS
+            return P(*spec)
+    return P()
+
+
+def shard_params_over_model(tree, mesh: Mesh, model_shards: int):
+    """Place a param/updater pytree under the model_param_spec rule
+    (multiprocess-safe via mesh_lib.place)."""
+    return jax.tree_util.tree_map(
+        lambda a: mesh_lib.place(
+            a, NamedSharding(mesh, model_param_spec(a, model_shards)),
+            mesh), tree)
+
+
+def place_model_tp(net, mesh: Mesh, model_shards: int) -> None:
+    """Tensor-parallel model placement: params/updater state shard over
+    "model", layer state and rng replicate (shared by
+    TensorParallelWrapper and SequenceParallelWrapper's 3-D mode so the
+    placement policy cannot drift between them)."""
+    net.params_tree = shard_params_over_model(net.params_tree, mesh,
+                                              model_shards)
+    net.opt_state = shard_params_over_model(net.opt_state, mesh,
+                                            model_shards)
+    net.state_tree = mesh_lib.replicate(mesh, net.state_tree)
+    net._rng = mesh_lib.replicate(mesh, net._rng)
+
+
+def jit_tp_step(net):
+    """Jit the net's raw train step with ONLY the param/updater output
+    shardings pinned (so GSPMD cannot drift the tensor-parallel layout
+    step-over-step; donation reuses the buffers in place). State stays
+    unconstrained ON PURPOSE: under tBPTT/rnn_time_step the state
+    pytree gains recurrent-carry keys, and a pinned sharding tree built
+    from the carry-free state_tree would structure-mismatch."""
+    sh = lambda t: jax.tree_util.tree_map(lambda a: a.sharding, t)
+    out_sh = (sh(net.params_tree), sh(net.opt_state),
+              None, None, None, None)
+    return jax.jit(net._train_step_raw, donate_argnums=(0, 1, 2),
+                   out_shardings=out_sh)
+
+
 class TensorParallelWrapper:
     """Drop-in TP/DP x TP trainer for MultiLayerNetwork and
     ComputationGraph (conv kernels [kh, kw, in, out] shard out-channels;
@@ -71,53 +123,18 @@ class TensorParallelWrapper:
 
     # -------------------------------------------------------------- sharding
     def _param_spec(self, arr) -> P:
-        """Shard the last divisible dim over "model"; replicate others."""
-        shape = np.shape(arr)
-        if len(shape) == 0 or not jnp.issubdtype(
-                jnp.asarray(arr).dtype, jnp.floating):
-            return P()
-        for dim in range(len(shape) - 1, -1, -1):
-            if shape[dim] >= self.model_shards and \
-                    shape[dim] % self.model_shards == 0:
-                spec = [None] * len(shape)
-                spec[dim] = mesh_lib.MODEL_AXIS
-                return P(*spec)
-        return P()
+        return model_param_spec(arr, self.model_shards)
 
     def _shard_tree(self, tree):
-        # mesh_lib.place, not raw device_put: placement stays correct on
-        # multi-host meshes (device_put cannot address remote devices)
-        return jax.tree_util.tree_map(
-            lambda a: mesh_lib.place(
-                a, NamedSharding(self.mesh, self._param_spec(a)),
-                self.mesh), tree)
+        return shard_params_over_model(tree, self.mesh, self.model_shards)
 
     def _place_model(self):
-        net = self.model
-        net.params_tree = self._shard_tree(net.params_tree)
-        # updater state mirrors param shapes leaf-for-leaf, so the same
-        # shape-based rule gives consistent placement
-        net.opt_state = self._shard_tree(net.opt_state)
-        net.state_tree = mesh_lib.replicate(self.mesh, net.state_tree)
-        net._rng = mesh_lib.replicate(self.mesh, net._rng)
+        place_model_tp(self.model, self.mesh, self.model_shards)
         self._placed = True
 
     def _ensure_step(self):
-        if self._step is not None:
-            return
-        net = self.model
-        sh = lambda t: jax.tree_util.tree_map(lambda a: a.sharding, t)
-        # Pin ONLY the param/updater output shardings so GSPMD cannot
-        # drift the layout step-over-step (donation reuses the buffers in
-        # place). State stays unconstrained: under tBPTT/rnn_time_step
-        # the state pytree gains recurrent-carry keys, and a pinned
-        # sharding tree built from the carry-free state_tree would
-        # structure-mismatch.
-        out_sh = (sh(net.params_tree), sh(net.opt_state),
-                  None, None, None, None)
-        self._step = jax.jit(net._train_step_raw,
-                             donate_argnums=(0, 1, 2),
-                             out_shardings=out_sh)
+        if self._step is None:
+            self._step = jit_tp_step(self.model)
 
     # ------------------------------------------------------------------- fit
     def fit(self, data, labels=None, *, epochs: int = 1,

@@ -139,6 +139,59 @@ class TestSequenceParallelTraining:
         assert net.iteration == 2  # one batch per epoch
 
 
+class TestThreeDParallel:
+    def test_dp_tp_sp_fit_matches_single_device(self):
+        """Full 3-D parallelism: batch over "data" (2), params + heads
+        over "model" (2), time over "seq" (2) — one wrapper, 8 devices,
+        == single-device training, with params DEMONSTRABLY sharded."""
+        x, y = _data()
+        single = MultiLayerNetwork(_conf()).init()
+        sharded = MultiLayerNetwork(_conf()).init()
+        w = SequenceParallelWrapper(
+            sharded, seq_parallel_mesh(data_devices=2, model_devices=2))
+        assert (w.data_shards, w.model_shards, w.seq_shards) == (2, 2, 2)
+        ds = DataSet(x, y)
+        for _ in range(2):
+            single._fit_batch(ds)
+            w.fit_batch(ds)
+        # param sharding evidence: Wq [8,16] sharded over "model"
+        spec = sharded.params_tree[0]["Wq"].sharding.spec
+        assert "model" in tuple(spec), spec
+        for ps, pw in zip(single.params_tree, sharded.params_tree):
+            for k in ps:
+                np.testing.assert_allclose(
+                    np.asarray(ps[k]), np.asarray(pw[k]),
+                    rtol=2e-4, atol=2e-5, err_msg=k)
+
+    def test_indivisible_heads_fall_back_to_replicated(self):
+        """n_heads=2 on a 4-way model axis: heads can't shard; the ring
+        falls back to replicated heads but params still shard where
+        divisible — training still matches single-device."""
+        def conf():
+            return (NeuralNetConfiguration.builder().seed(7)
+                    .updater(Sgd(0.1)).list()
+                    .layer(SelfAttentionLayer(n_out=16, n_heads=2,
+                                              causal=True))
+                    .layer(RnnOutputLayer(n_out=3, activation="softmax",
+                                          loss="mcxent"))
+                    .set_input_type(InputType.recurrent(8)).build())
+        x, y = _data(seed=9)
+        single = MultiLayerNetwork(conf()).init()
+        sharded = MultiLayerNetwork(conf()).init()
+        w = SequenceParallelWrapper(
+            sharded, seq_parallel_mesh(model_devices=4))
+        assert w.model_shards == 4 and w.seq_shards == 2
+        ds = DataSet(x, y)
+        for _ in range(2):
+            single._fit_batch(ds)
+            w.fit_batch(ds)
+        for ps, pw in zip(single.params_tree, sharded.params_tree):
+            for k in ps:
+                np.testing.assert_allclose(
+                    np.asarray(ps[k]), np.asarray(pw[k]),
+                    rtol=2e-4, atol=2e-5, err_msg=k)
+
+
 class TestSequenceParallelGraph:
     def _gconf(self, seed=9):
         from deeplearning4j_tpu import ComputationGraph
@@ -189,7 +242,7 @@ class TestSequenceParallelContext:
         mesh = seq_parallel_mesh()
         assert active_sequence_parallel() is None
         with sequence_parallel(mesh, "seq", None):
-            assert active_sequence_parallel() == (mesh, "seq", None)
+            assert active_sequence_parallel() == (mesh, "seq", None, None)
         assert active_sequence_parallel() is None
 
     def test_layer_falls_back_when_indivisible(self):
