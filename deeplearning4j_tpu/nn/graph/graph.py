@@ -245,6 +245,9 @@ class ComputationGraph(DeviceIterationMixin):
             lambda params, state, inputs, fmasks:
             [self._walk(params, state, inputs, False, None, fmasks)[0][n]
              for n in conf.network_outputs])
+        self._ff_named_fn = jax.jit(
+            lambda params, state, inputs:
+            self._walk(params, state, inputs, False, None, {})[0])
         self._loss_fn_jit = jax.jit(
             lambda params, state, inputs, labels, fmasks, lmasks:
             self._loss_pure(params, state, inputs, labels, fmasks, lmasks,
@@ -581,6 +584,24 @@ class ComputationGraph(DeviceIterationMixin):
 
     def output(self, *features, features_masks=None) -> np.ndarray:
         return self.outputs(*features, features_masks=features_masks)[0]
+
+    def feed_forward_named(self, *features) -> Dict[str, np.ndarray]:
+        """{node name: activation} for one inference forward pass over
+        EVERY vertex, inputs included (reference
+        ComputationGraph.feedForward() returning the activations map).
+        Jitted once; the public surface listeners use to inspect
+        intermediate activations (ui.convolutional)."""
+        self._check_init()
+        conf = self.conf
+        if len(features) == 1 and isinstance(features[0], (list, tuple)):
+            features = tuple(features[0])
+        if len(features) != len(conf.network_inputs):
+            raise ValueError(f"Graph has {len(conf.network_inputs)} inputs, "
+                             f"got {len(features)}")
+        inputs = {n: jnp.asarray(f)
+                  for n, f in zip(conf.network_inputs, features)}
+        acts = self._ff_named_fn(self.params_tree, self.state_tree, inputs)
+        return {n: np.asarray(a) for n, a in acts.items()}
 
     def predict(self, *features) -> np.ndarray:
         return np.argmax(self.output(*features), axis=-1)

@@ -88,17 +88,28 @@ class ConvolutionalIterationListener(IterationListener):
         self._ui = ui
 
     def _grids(self, model) -> List[Tuple[str, bytes]]:
-        acts = model.feed_forward(self.probe)
         out = []
-        layers = getattr(model, "layers", [])
-        # feed_forward returns [input] + per-layer activations
-        for i, act in enumerate(acts[1:]):
+        if hasattr(model, "feed_forward_named"):  # ComputationGraph
+            if len(model.conf.network_inputs) != 1:
+                raise ValueError(
+                    "ConvolutionalIterationListener supports single-input "
+                    "graphs (one probe); got inputs "
+                    f"{model.conf.network_inputs}")
+            acts = model.feed_forward_named(self.probe)
+            skip = set(model.conf.network_inputs)
+            named = [(n, acts[n]) for n in model.conf.topo_order
+                     if n in acts and n not in skip]
+        else:  # MultiLayerNetwork: [input] + per-layer activations
+            ff = model.feed_forward(self.probe)
+            layers = getattr(model, "layers", [])
+            named = [(f"layer{i} "
+                      f"({type(layers[i]).__name__ if i < len(layers) else '?'})",
+                      act) for i, act in enumerate(ff[1:])]
+        for name, act in named:
             a = np.asarray(act)
             if a.ndim != 4:
                 continue  # not a spatial activation
-            name = (f"layer{i} "
-                    f"({type(layers[i]).__name__ if i < len(layers) else '?'})")
-            out.append((name, png_gray(
+            out.append((str(name), png_gray(
                 activation_grid(a[0], max_channels=self.max_channels))))
         return out
 

@@ -85,6 +85,39 @@ class TestConvolutionalModule:
             server.stop()
 
 
+class TestConvolutionalModuleGraph:
+    def test_graph_activations_render(self):
+        """ComputationGraph CNNs get the activations view too (the
+        reference listener worked on both network types)."""
+        from deeplearning4j_tpu import ComputationGraph, Sgd
+        from deeplearning4j_tpu.data.dataset import MultiDataSet
+        conf = (NeuralNetConfiguration.builder().seed(4).updater(Sgd(0.1))
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("conv", ConvolutionLayer(
+                    kernel_size=(3, 3), stride=(1, 1), padding=(1, 1),
+                    n_out=4, activation="relu"), "in")
+                .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                              loss="mcxent"), "conv")
+                .set_outputs("out")
+                .set_input_types(InputType.convolutional(8, 8, 1))
+                .build())
+        g = ComputationGraph(conf).init()
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((4, 8, 8, 1)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 4)]
+        server = UIServer(port=0).start()
+        try:
+            g.listeners.append(ConvolutionalIterationListener(
+                probe=x[0], frequency=1, ui=server))
+            g.fit_batch(MultiDataSet([x], [y]))
+            page = _get(server.url + "/activations")
+            assert page.count(b"data:image/png;base64,") == 1
+            assert b"conv" in page
+        finally:
+            server.stop()
+
+
 class TestUiComponents:
     def _tree(self):
         return ComponentDiv(
