@@ -266,16 +266,37 @@ class SequenceParallelWrapper:
 
     def output(self, x, features_mask=None):
         """Sequence-parallel inference through the same ring path (own
-        jit so the net's cached forward stays dense).
-        MultiLayerNetwork only — use net.outputs() for graphs."""
+        jit so the net's cached forward stays dense). For a
+        ComputationGraph, `x` is the single network input (time sharded
+        like training) and the FIRST network output returns."""
         net = self.model
         net._check_init()
-        if hasattr(net, "_pack"):  # ComputationGraph has no _forward_pure
-            raise NotImplementedError(
-                "sequence-parallel output() supports MultiLayerNetwork "
-                "only; run ComputationGraph inference via net.outputs()")
         if not self._placed:
             self._place_model()
+        if hasattr(net, "_pack"):  # ComputationGraph
+            if len(net.conf.network_inputs) != 1:
+                raise NotImplementedError(
+                    "sequence-parallel output() supports single-input "
+                    "graphs; use net.outputs() for multi-input inference")
+            if isinstance(x, (list, tuple)) and len(x) == 1:
+                x = x[0]  # graph.output([x]) convention
+            if np.shape(x)[1] % self.seq_shards:
+                raise ValueError(
+                    f"time axis {np.shape(x)[1]} must divide the "
+                    f"{self.seq_shards}-way seq axis")
+            if self._out_fn is None:
+                name = net.conf.network_inputs[0]
+                out_name = net.conf.network_outputs[0]
+                self._out_fn = jax.jit(
+                    lambda params, state, xx, fm:
+                    net._walk(params, state, {name: xx}, False, None,
+                              {} if fm is None else {name: fm}
+                              )[0][out_name])
+            xs = self._shard_bt(x, True, cast_dtype=net._dtype)
+            fm = self._shard_bt(features_mask, True)
+            with self._ctx(), self.mesh:
+                out = self._out_fn(net.params_tree, net.state_tree, xs, fm)
+            return np.asarray(out)
         if self._out_fn is None:
             self._out_fn = jax.jit(
                 lambda params, state, xx, fm:

@@ -228,6 +228,20 @@ class TestSequenceParallelGraph:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-4, atol=2e-5)
 
+    def test_graph_output_matches(self):
+        """Sequence-parallel graph inference returns the dense result
+        (masked variable-length sequences included)."""
+        x, _ = _data(seed=15)
+        fmask = np.ones((8, 16), np.float32)
+        fmask[:, 12:] = 0.0
+        g_ref = self._gconf(seed=21)
+        ref = g_ref.output(x, features_masks=[fmask])
+        w = SequenceParallelWrapper(g_ref, seq_parallel_mesh())
+        out = w.output(x, features_mask=fmask)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+        with pytest.raises(ValueError, match="divide"):
+            w.output(np.zeros((8, 10, 8), np.float32))
+
     def test_graph_indivisible_batch_rejected(self):
         from deeplearning4j_tpu.data.dataset import MultiDataSet
         x, y = _data(n=7)
