@@ -598,8 +598,10 @@ class ComputationGraph(DeviceIterationMixin):
         if len(features) != len(conf.network_inputs):
             raise ValueError(f"Graph has {len(conf.network_inputs)} inputs, "
                              f"got {len(features)}")
-        inputs = {n: jnp.asarray(f)
-                  for n, f in zip(conf.network_inputs, features)}
+        # _pack_inputs applies the same net-dtype cast every other
+        # forward path uses: on a bf16 net the probe forward must match
+        # training precision, not trace a second f32 jit variant
+        inputs, _ = self._pack_inputs(features)
         acts = self._ff_named_fn(self.params_tree, self.state_tree, inputs)
         return {n: np.asarray(a) for n, a in acts.items()}
 

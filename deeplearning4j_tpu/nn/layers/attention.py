@@ -90,7 +90,25 @@ class SelfAttentionLayer(Layer):
         k = (x @ params[W_K] + params[B_K]).reshape(b, t, h, d)
         v = (x @ params[W_V] + params[B_V]).reshape(b, t, h, d)
         sp = active_sequence_parallel()
-        if sp is not None and t % int(sp[0].shape[sp[1]]) == 0:
+        use_ring = False
+        if sp is not None:
+            seq_shards = int(sp[0].shape[sp[1]])
+            use_ring = t % seq_shards == 0
+            if not use_ring and not getattr(
+                    SelfAttentionLayer, "_warned_time_fallback", False):
+                # indivisible time (e.g. a short final tBPTT window):
+                # dense fallback — mathematically identical but without
+                # the ring's O(T^2/N) memory property; warn once so
+                # inactive sequence parallelism is visible (mirrors the
+                # head-indivisible warn)
+                import logging
+                logging.getLogger(__name__).warning(
+                    "sequence length %d does not divide the %d-way '%s' "
+                    "mesh axis; attention runs dense (sequence "
+                    "parallelism inactive for this window)",
+                    t, seq_shards, sp[1])
+                SelfAttentionLayer._warned_time_fallback = True
+        if use_ring:
             # Sequence-parallel training (SequenceParallelWrapper active):
             # time is sharded over the mesh's seq axis, so attention runs
             # the ppermute ring instead of materializing [t, t] scores —

@@ -83,6 +83,7 @@ class SequenceParallelWrapper:
         self._out_fn = None
         self._placed = False
         self._warned_pad = False
+        self._warned_window = False
 
     def _ctx(self):
         return sequence_parallel(self.mesh, mesh_lib.SEQ_AXIS,
@@ -127,7 +128,10 @@ class SequenceParallelWrapper:
         if time_sharded and a.ndim >= 2:
             axes.append(mesh_lib.SEQ_AXIS)
         spec = P(*axes) if len(axes) > 1 else P(axes[0])
-        return jax.device_put(a, NamedSharding(self.mesh, spec))
+        # mesh_lib.place (not raw device_put): on a multi-process mesh
+        # device_put cannot address remote devices — the same rule
+        # TensorParallelWrapper._put_batch follows.
+        return mesh_lib.place(a, NamedSharding(self.mesh, spec), self.mesh)
 
     # ------------------------------------------------------------------- fit
     def fit(self, data, labels=None, *, epochs: int = 1,
@@ -160,24 +164,87 @@ class SequenceParallelWrapper:
         Exactly the net's math: the only difference from single-device
         training is WHERE each time slice lives (+ f32 reassociation in
         the ring's online softmax). Accepts a DataSet for
-        MultiLayerNetwork or a (Multi)DataSet for ComputationGraph."""
+        MultiLayerNetwork or a (Multi)DataSet for ComputationGraph.
+
+        Delegates to the net's own batch dispatch with a sharded
+        do_step (the TensorParallelWrapper / ParallelWrapper contract),
+        so recurrent-carry reset and tBPTT windowing can never diverge
+        from the single-device path."""
         net = self.model
         net._check_init()
         if not self._placed:
             self._place_model()
         self._ensure_step()
+        # np.ndim/np.shape read attributes without materializing
+        # device-resident arrays on the host
         if hasattr(net, "_pack"):  # ComputationGraph
-            self._fit_batch_graph(ds)
+            mds = net._coerce(ds)
+            self._check_tbptt_windows(
+                max((np.shape(f)[1] for f in mds.features
+                     if np.ndim(f) == 3), default=0),
+                windowing=all(np.ndim(l) == 3 for l in mds.labels))
+            net.fit_batch(mds, do_step=self._sp_graph_step)
             return
-        x = jnp.asarray(ds.features)
-        t = x.shape[1]
-        if t % self.seq_shards:
+        self._check_tbptt_windows(
+            np.shape(ds.features)[1] if np.ndim(ds.features) == 3 else 0,
+            windowing=np.ndim(ds.labels) == 3)
+        net._fit_batch(ds, do_step=self._sp_step)
+
+    def _check_tbptt_windows(self, T: int, windowing: bool) -> None:
+        """If tBPTT windowing is about to run with a window length that
+        doesn't divide the seq axis, EVERY window would fall back to
+        dense attention — raise up front rather than silently training
+        the whole run without sequence parallelism. (A short FINAL
+        window is fine: it alone falls back, warned once.)"""
+        from ..nn.conf.builders import BackpropType
+        if self.model.conf.backprop_type != BackpropType.TRUNCATED_BPTT \
+                or not windowing or not T:
+            return
+        L = self.model.conf.tbptt_fwd_length
+        # the main window length is min(L, T): if IT doesn't divide,
+        # every window of the run is dense (a T<=L run has exactly one
+        # window of T steps). Only a short FINAL tail may fall back.
+        if min(L, T) % self.seq_shards:
+            raise ValueError(
+                f"tBPTT window length {min(L, T)} "
+                f"(min(tbptt_fwd_length={L}, T={T})) does not divide the "
+                f"{self.seq_shards}-way seq axis: every tBPTT window "
+                f"would fall back to dense attention; choose a window "
+                f"length divisible by the seq axis")
+
+    def _time_sharded_ok(self, t: int, windowed: bool) -> bool:
+        """Whether a [., t, ...] window can ride the ring. A short final
+        tBPTT window that doesn't divide the seq axis falls back to the
+        dense path (warn once); a whole-sequence (non-windowed) batch
+        raises instead — silent full-dense training is never the answer
+        the user asked the wrapper for."""
+        if t % self.seq_shards == 0:
+            return True
+        if not windowed:
             raise ValueError(
                 f"time axis {t} must divide the {self.seq_shards}-way seq "
                 f"axis")
-        y = jnp.asarray(ds.labels)
-        fmask = ds.features_mask
-        lmask = ds.labels_mask
+        if not self._warned_window:
+            log.warning(
+                "tBPTT window of %d steps does not divide the %d-way seq "
+                "axis; this window runs dense (sequence parallelism "
+                "inactive for it)", t, self.seq_shards)
+            self._warned_window = True
+        return False
+
+    def _sp_step(self, x, y, fmask, lmask) -> None:
+        """do_step callback for MultiLayerNetwork._fit_batch: shard one
+        (possibly tBPTT-windowed) batch over the mesh and commit."""
+        net = self.model
+        x = jnp.asarray(x)
+        y = jnp.asarray(y)
+        t = x.shape[1]
+        # _fit_tbptt seeds the recurrent carry before each window;
+        # the standard-BPTT path clears it — so a non-None carry is the
+        # reliable "this is a tBPTT window" signal (conf.backprop_type
+        # alone lies when rank-2 labels force the standard fallback).
+        windowed = net._rnn_carry is not None
+        time_ok = self._time_sharded_ok(t, windowed)
         pad = (-x.shape[0]) % self.data_shards
         if pad:
             # Short final batch (iterator tail): pad by repeating the
@@ -195,36 +262,51 @@ class SequenceParallelWrapper:
                 [jnp.asarray(a),
                  jnp.broadcast_to(jnp.asarray(a)[-1:],
                                   (pad,) + jnp.asarray(a).shape[1:])], 0)
-            if lmask is None:
-                lmask = jnp.ones(y.shape[:2] if y.ndim >= 3
-                                 else (y.shape[0], 1), jnp.float32)
-            x, y, fmask, lmask = rep(x), rep(y), rep(fmask), rep(lmask)
-            lmask = lmask.at[-pad:].set(0.0)
-        xs = self._shard_bt(x, True, cast_dtype=net._dtype)
-        ys = self._shard_bt(y, y.ndim >= 3)
-        fm = self._shard_bt(fmask, True)
+            from .wrapper import pad_lmask_zero_weight
+            lmask = pad_lmask_zero_weight(lmask, x.shape[0], pad)
+            x, y, fmask = rep(x), rep(y), rep(fmask)
+            if windowed:
+                # the recurrent carry was seeded at the UNPADDED batch
+                # (net._fit_tbptt); pad it the same way or the merged
+                # state shape-mismatches the padded window. Later
+                # windows re-enter with the carry already padded (the
+                # committed state keeps the padded batch), so only
+                # unpadded-size leading axes grow.
+                n0 = x.shape[0] - pad
+                padc = lambda v: rep(v) if jnp.asarray(v).ndim and \
+                    jnp.asarray(v).shape[0] == n0 else v
+                net._rnn_carry = tuple(
+                    {k: padc(v) for k, v in c.items()}
+                    for c in net._rnn_carry)
+        xs = self._shard_bt(x, time_ok, cast_dtype=net._dtype)
+        ys = self._shard_bt(y, time_ok and y.ndim >= 3)
+        fm = self._shard_bt(fmask, time_ok)
         # a [batch, 1] per-example weight mask has no time axis to shard
-        lm = self._shard_bt(lmask, lmask is not None and
+        lm = self._shard_bt(lmask, time_ok and lmask is not None and
                             jnp.asarray(lmask).ndim >= 2 and
                             jnp.asarray(lmask).shape[1] == t)
+        self._run_sharded(xs, ys, fm, lm)
+
+    def _run_sharded(self, *packed) -> None:
+        """Swap in the ring-routed step for one commit (restored after),
+        the sequence-parallel context held across the call so the first
+        trace (and any retrace) sees it."""
+        net = self.model
         orig = net._train_step_fn
         net._train_step_fn = self._step
         try:
-            # context held across the CALL so the first call's trace (and
-            # any retrace) sees it
             with self._ctx():
-                net._run_and_commit(xs, ys, fm, lm, mesh=self.mesh)
+                net._run_and_commit(*packed, mesh=self.mesh)
         finally:
             net._train_step_fn = orig
 
-    def _fit_batch_graph(self, ds) -> None:
-        """ComputationGraph step: every rank-3 dict entry gets
-        [batch, time] sharded; rank-2 entries (static inputs,
-        per-example masks) shard batch only. Batch must divide the data
-        axis (the graph's multi-head masks make zero-weight padding
-        head-specific; repartition instead)."""
+    def _sp_graph_step(self, inputs, labels, fm, lm) -> None:
+        """do_step callback for ComputationGraph.fit_batch: every rank-3
+        dict entry gets [batch, time] sharded; rank-2 entries (static
+        inputs, per-example masks) shard batch only. Batch must divide
+        the data axis (the graph's multi-head masks make zero-weight
+        padding head-specific; repartition instead)."""
         net = self.model
-        inputs, labels, fm, lm = net._pack(net._coerce(ds))
         n = next(iter(inputs.values())).shape[0]
         if n % self.data_shards:
             raise ValueError(
@@ -232,11 +314,12 @@ class SequenceParallelWrapper:
                 f"axis (no padding for graph batches)")
         t_axes = {a.shape[1] for a in inputs.values()
                   if hasattr(a, "ndim") and a.ndim == 3}
-        for t in t_axes:
-            if t % self.seq_shards:
-                raise ValueError(
-                    f"time axis {t} must divide the {self.seq_shards}-way "
-                    f"seq axis")
+        # non-None carry == graph._fit_tbptt seeded a window (see
+        # _sp_step); a short final window falls back to dense with a
+        # warning, a whole-sequence indivisible time raises.
+        windowed = net._rnn_carry is not None
+        shardable = {t for t in t_axes
+                     if self._time_sharded_ok(t, windowed)}
 
         def shard_dict(d, cast=None, is_mask=False):
             # rank-3 tensors carry [batch, time, features]; rank-2 MASK
@@ -247,22 +330,15 @@ class SequenceParallelWrapper:
                 if v is None:
                     return False
                 if np.ndim(v) == 3:
-                    return np.shape(v)[1] in t_axes
+                    return np.shape(v)[1] in shardable
                 return is_mask and np.ndim(v) == 2 and \
-                    np.shape(v)[1] in t_axes
+                    np.shape(v)[1] in shardable
             return {k: self._shard_bt(v, tsh(v), cast_dtype=cast)
                     for k, v in d.items()}
 
-        packed = (shard_dict(inputs, cast=net._dtype), shard_dict(labels),
-                  shard_dict(fm, is_mask=True),
-                  shard_dict(lm, is_mask=True))
-        orig = net._train_step_fn
-        net._train_step_fn = self._step
-        try:
-            with self._ctx():
-                net._run_and_commit(*packed, mesh=self.mesh)
-        finally:
-            net._train_step_fn = orig
+        self._run_sharded(shard_dict(inputs, cast=net._dtype),
+                          shard_dict(labels), shard_dict(fm, is_mask=True),
+                          shard_dict(lm, is_mask=True))
 
     def output(self, x, features_mask=None):
         """Sequence-parallel inference through the same ring path (own

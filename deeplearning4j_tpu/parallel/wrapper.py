@@ -40,6 +40,33 @@ from ..nn.layers.recurrent import RECURRENT_CARRY_KEYS
 log = logging.getLogger(__name__)
 
 
+def pad_lmask_zero_weight(lmask, n: int, pad: int):
+    """The zero-weight pad-mask contract, shared by ParallelWrapper and
+    SequenceParallelWrapper so it cannot drift: a labels mask covering
+    `pad` appended rows, constructed so the LOSS (numerator and
+    normalization) exactly matches single-device training on the
+    original `n`-row batch:
+      * no user mask  -> ones (n,1) + zero pad rows; the rank-2 mask
+        path divides by sum(mask) = n, preserving the unmasked
+        time-sum/batch-mean semantics (an (n,T) ones mask would NOT —
+        it flips the denominator to n*T).
+      * rank-1 user mask (per-example weights) -> zero-padded and
+        scaled by padded_n/n; the rank-1 mean path then yields
+        sum(sa*m)/n, the unpadded value (exact by linearity).
+      * rank>=2 user mask -> zero pad rows; sum(mask) is unchanged."""
+    if lmask is None:
+        m = np.ones((n, 1), np.float32)
+    else:
+        m = np.asarray(lmask, np.float32)
+    zeros = np.zeros((pad,) + m.shape[1:], m.dtype)
+    out = np.concatenate([m, zeros], axis=0)
+    if out.ndim == 1:
+        # Rank-1 masks take the mean-over-batch loss path; rescale so
+        # mean over padded_n equals the unpadded mean over n.
+        out = out * (out.shape[0] / float(n))
+    return out
+
+
 class ParallelWrapper:
     """Drop-in DP trainer for MultiLayerNetwork / ComputationGraph
     (reference ParallelWrapper.Builder surface, minus the thread zoo)."""
@@ -160,17 +187,7 @@ class ParallelWrapper:
                 "and dropout draws include the pad rows — use divisible "
                 "batch sizes for bit-exact equivalence", n, self.data_shards)
             self._warned_pad = True
-        if lmask is None:
-            m = np.ones((n, 1), np.float32)
-        else:
-            m = np.asarray(lmask, np.float32)
-        zeros = np.zeros((pad,) + m.shape[1:], m.dtype)
-        out = np.concatenate([m, zeros], axis=0)
-        if out.ndim == 1:
-            # Rank-1 masks take the mean-over-batch loss path; rescale so
-            # mean over padded_n equals the unpadded mean over n.
-            out = out * (out.shape[0] / float(n))
-        return out
+        return pad_lmask_zero_weight(lmask, n, pad)
 
     # -------------------------------------------------------------------- fit
     def fit(self, data, labels=None, *, epochs: int = 1,

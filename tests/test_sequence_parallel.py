@@ -92,6 +92,110 @@ class TestSequenceParallelTraining:
         out = w.output(x)
         np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
 
+    def test_tbptt_windows_under_sp(self):
+        """A truncated-BPTT net under the SP wrapper runs the net's own
+        window schedule (fit_batch delegates via do_step — ADVICE r4
+        medium), matching single-device param-for-param and
+        iteration-for-iteration; each 8-step window still rides the
+        ring (8 divides the 8-way seq axis)."""
+        from deeplearning4j_tpu.nn.conf.builders import BackpropType
+        conf = lambda: (NeuralNetConfiguration.builder().seed(9)
+                        .updater(Sgd(0.1)).list()
+                        .layer(SelfAttentionLayer(n_out=16, n_heads=4))
+                        .layer(RnnOutputLayer(n_out=3, activation="softmax",
+                                              loss="mcxent"))
+                        .set_input_type(InputType.recurrent(8))
+                        .backprop_type(BackpropType.TRUNCATED_BPTT)
+                        .tbptt_fwd_length(8).tbptt_back_length(8)
+                        .build())
+        x, y = _data(seed=11)
+        single = MultiLayerNetwork(conf()).init()
+        sharded = MultiLayerNetwork(conf()).init()
+        w = SequenceParallelWrapper(sharded, seq_parallel_mesh())
+        ds = DataSet(x, y)
+        for _ in range(2):
+            single._fit_batch(ds)
+            w.fit_batch(ds)
+        # 2 batches x (16/8)=2 windows = 4 optimizer steps each
+        assert single.iteration == sharded.iteration == 4
+        for ps, pw in zip(single.params_tree, sharded.params_tree):
+            for k in ps:
+                np.testing.assert_allclose(
+                    np.asarray(ps[k]), np.asarray(pw[k]),
+                    rtol=2e-4, atol=2e-5, err_msg=k)
+
+    @staticmethod
+    def _tbptt_conf(fwd):
+        from deeplearning4j_tpu.nn.conf.builders import BackpropType
+        return (NeuralNetConfiguration.builder().seed(9)
+                .updater(Sgd(0.1)).list()
+                .layer(SelfAttentionLayer(n_out=16, n_heads=4))
+                .layer(RnnOutputLayer(n_out=3, activation="softmax",
+                                      loss="mcxent"))
+                .set_input_type(InputType.recurrent(8))
+                .backprop_type(BackpropType.TRUNCATED_BPTT)
+                .tbptt_fwd_length(fwd).tbptt_back_length(fwd)
+                .build())
+
+    def test_tbptt_short_final_window_dense_fallback(self):
+        """A short FINAL tBPTT window that doesn't divide the seq axis
+        falls back to the dense path (warned once) instead of raising —
+        and parity with single-device still holds window-for-window."""
+        x, y = _data(seed=12, T=12)  # L=8 -> windows of 8 and 4
+        single = MultiLayerNetwork(self._tbptt_conf(8)).init()
+        sharded = MultiLayerNetwork(self._tbptt_conf(8)).init()
+        w = SequenceParallelWrapper(sharded, seq_parallel_mesh())
+        ds = DataSet(x, y)
+        single._fit_batch(ds)
+        w.fit_batch(ds)
+        assert single.iteration == sharded.iteration == 2
+        assert w._warned_window  # the fallback announced itself
+        for ps, pw in zip(single.params_tree, sharded.params_tree):
+            for k in ps:
+                np.testing.assert_allclose(
+                    np.asarray(ps[k]), np.asarray(pw[k]),
+                    rtol=2e-4, atol=2e-5, err_msg=k)
+
+    def test_tbptt_indivisible_window_length_rejected_up_front(self):
+        """tbptt_fwd_length that doesn't divide the seq axis would make
+        EVERY window dense — rejected before any step runs."""
+        x, y = _data(seed=12)  # T=16, L=12: every main window indivisible
+        net = MultiLayerNetwork(self._tbptt_conf(12)).init()
+        w = SequenceParallelWrapper(net, seq_parallel_mesh())
+        with pytest.raises(ValueError, match="tbptt_fwd_length"):
+            w.fit_batch(DataSet(x, y))
+        assert net.iteration == 0  # nothing mutated
+
+    def test_tbptt_recurrent_carry_pads_with_batch(self):
+        """tBPTT + a recurrent layer + a batch not divisible by the data
+        axis: the seeded carry (h/c at the unpadded batch) pads alongside
+        the window, and zero-loss-weight pad rows leave parity intact."""
+        from deeplearning4j_tpu.nn.conf.builders import BackpropType
+        from deeplearning4j_tpu.nn.layers.recurrent import GravesLSTM
+        conf = lambda: (NeuralNetConfiguration.builder().seed(15)
+                        .updater(Sgd(0.1)).list()
+                        .layer(GravesLSTM(n_out=12, activation="tanh"))
+                        .layer(RnnOutputLayer(n_out=3, activation="softmax",
+                                              loss="mcxent"))
+                        .set_input_type(InputType.recurrent(8))
+                        .backprop_type(BackpropType.TRUNCATED_BPTT)
+                        .tbptt_fwd_length(8).tbptt_back_length(8)
+                        .build())
+        x, y = _data(seed=16, n=7)  # 7 % 2 data shards -> pad 1
+        single = MultiLayerNetwork(conf()).init()
+        sharded = MultiLayerNetwork(conf()).init()
+        w = SequenceParallelWrapper(
+            sharded, seq_parallel_mesh(data_devices=2, seq_devices=4))
+        ds = DataSet(x, y)
+        single._fit_batch(ds)
+        w.fit_batch(ds)  # must not shape-mismatch on the merged carry
+        assert single.iteration == sharded.iteration == 2
+        for ps, pw in zip(single.params_tree, sharded.params_tree):
+            for k in ps:
+                np.testing.assert_allclose(
+                    np.asarray(ps[k]), np.asarray(pw[k]),
+                    rtol=2e-4, atol=2e-5, err_msg=k)
+
     def test_net_dense_path_unpolluted(self):
         """After sequence-parallel training, plain net.fit/output still
         runs the dense path (the wrapper's jit is separate)."""
@@ -114,7 +218,9 @@ class TestSequenceParallelTraining:
         """An iterator tail batch not divisible by the data axis pads
         with zero-loss-weight rows instead of crashing mid-epoch (the
         ParallelWrapper padding contract)."""
-        x, y = _data(n=10)  # batch_size 8 -> final batch of 2 on dp=2
+        # batch_size 8 -> final batch of 1 on dp=2: REALLY pads (a tail
+        # of 2 would divide the data axis and never take the pad path)
+        x, y = _data(n=9)
         single = MultiLayerNetwork(_conf()).init()
         sharded = MultiLayerNetwork(_conf()).init()
         w = SequenceParallelWrapper(sharded,
@@ -122,6 +228,7 @@ class TestSequenceParallelTraining:
         single.fit(DataSet(x, y), epochs=1, batch_size=8, use_async=False)
         w.fit(DataSet(x, y), epochs=1, batch_size=8)
         assert sharded.iteration == 2
+        assert w._warned_pad  # the pad path actually ran
         for ps, pw in zip(single.params_tree, sharded.params_tree):
             for k in ps:
                 np.testing.assert_allclose(
