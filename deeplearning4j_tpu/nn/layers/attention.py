@@ -14,8 +14,8 @@ from dataclasses import dataclass
 
 import jax.numpy as jnp
 
-from ...ops.attention import (active_sequence_parallel, dense_attention,
-                              ring_self_attention)
+from ...ops.attention import (active_sequence_parallel, blockwise_attention,
+                              dense_attention, ring_self_attention)
 from ...utils import serde
 from .core import Layer, dropout
 
@@ -35,6 +35,13 @@ class SelfAttentionLayer(Layer):
     n_out: int = 0
     n_heads: int = 4
     causal: bool = False
+    # Single-device long-context routing: 0 = auto (blockwise
+    # flash-style attention once t >= 2048; block probe order 512,
+    # 1024, 256, 128 — 512 measured fastest on v5e, docs/
+    # perf_attention.md), -1 = always dense, >0 = that block size
+    # whenever it divides t. Blockwise is bit-comparable to dense up to
+    # f32 reassociation (ops/attention.py, tests/test_attention.py).
+    block_size: int = 0
 
     def input_kind(self):
         return "rnn"
@@ -80,6 +87,23 @@ class SelfAttentionLayer(Layer):
             p[name] = jnp.zeros((n,), dtype)
         return p
 
+    def _pick_block(self, t: int) -> int:
+        """Block size for single-device blockwise attention; 0 = dense.
+        See the block_size field doc for the policy."""
+        if self.block_size == -1:
+            return 0
+        if self.block_size > 0:
+            return self.block_size if t % self.block_size == 0 \
+                and t > self.block_size else 0
+        if t < 2048:
+            return 0
+        # 512 first: measured fastest on v5e (bf16, d<=128 heads) —
+        # 4k/8k/16k sweeps in docs/perf_attention.md
+        for blk in (512, 1024, 256, 128):
+            if t % blk == 0:
+                return blk
+        return 0
+
     def forward(self, params, state, x, *, train=False, rng=None,
                 mask=None):
         x = dropout(x, self.dropout_rate, train, rng)
@@ -104,9 +128,9 @@ class SelfAttentionLayer(Layer):
                 import logging
                 logging.getLogger(__name__).warning(
                     "sequence length %d does not divide the %d-way '%s' "
-                    "mesh axis; attention runs dense (sequence "
-                    "parallelism inactive for this window)",
-                    t, seq_shards, sp[1])
+                    "mesh axis; attention runs unsharded (dense or "
+                    "blockwise — sequence parallelism inactive for this "
+                    "window)", t, seq_shards, sp[1])
                 SelfAttentionLayer._warned_time_fallback = True
         if use_ring:
             # Sequence-parallel training (SequenceParallelWrapper active):
@@ -135,8 +159,14 @@ class SelfAttentionLayer(Layer):
                                       batch_axis=batch_axis,
                                       head_axis=head_axis)
         else:
-            out = dense_attention(q, k, v, causal=self.causal,
-                                  key_mask=mask)
+            blk = self._pick_block(t)
+            if blk:
+                out = blockwise_attention(q, k, v, causal=self.causal,
+                                          key_mask=mask, q_block=blk,
+                                          kv_block=blk)
+            else:
+                out = dense_attention(q, k, v, causal=self.causal,
+                                      key_mask=mask)
         out = out.reshape(b, t, self.n_out)
         out = out @ params[W_O] + params[B_O]
         out = self._act()(out)

@@ -9,9 +9,106 @@ import pytest
 from deeplearning4j_tpu import (Adam, DataSet, InputType, MultiLayerNetwork,
                                 NeuralNetConfiguration, RnnOutputLayer)
 from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer
-from deeplearning4j_tpu.ops.attention import (dense_attention,
+from deeplearning4j_tpu.ops.attention import (blockwise_attention,
+                                              dense_attention,
                                               ring_self_attention)
 from deeplearning4j_tpu.parallel.mesh import SEQ_AXIS, create_mesh
+
+
+class TestBlockwiseAttention:
+    """Single-device flash-style attention (the long-context path):
+    identical math to dense without the [T, T] materialization."""
+
+    def _qkv(self, seed=0, B=2, T=64, H=4, D=16):
+        rng = np.random.default_rng(seed)
+        mk = lambda: jnp.asarray(rng.standard_normal((B, T, H, D)),
+                                 jnp.float32)
+        return mk(), mk(), mk()
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_dense(self, causal):
+        q, k, v = self._qkv()
+        ref = dense_attention(q, k, v, causal=causal)
+        out = blockwise_attention(q, k, v, causal=causal,
+                                  q_block=16, kv_block=16)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_matches_dense_with_key_mask(self):
+        q, k, v = self._qkv(seed=1)
+        rng = np.random.default_rng(2)
+        km = jnp.asarray(rng.random((2, 64)) > 0.3, jnp.float32)
+        ref = dense_attention(q, k, v, causal=True, key_mask=km)
+        out = blockwise_attention(q, k, v, causal=True, key_mask=km,
+                                  q_block=16, kv_block=16)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_fully_masked_query_rows_zero(self):
+        """A query whose keys are ALL masked outputs zero (the dense /
+        ring convention), not NaN from a 0/0 softmax."""
+        q, k, v = self._qkv(seed=3)
+        km = jnp.zeros((2, 64), jnp.float32)  # nothing valid
+        out = blockwise_attention(q, k, v, key_mask=km,
+                                  q_block=16, kv_block=16)
+        assert not np.isnan(np.asarray(out)).any()
+        np.testing.assert_allclose(np.asarray(out), 0.0)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_gradients_match_dense(self, causal):
+        q, k, v = self._qkv(seed=4, T=32)
+
+        def loss_dense(q, k, v):
+            return jnp.sum(dense_attention(q, k, v, causal=causal) ** 2)
+
+        def loss_blk(q, k, v):
+            return jnp.sum(blockwise_attention(
+                q, k, v, causal=causal, q_block=8, kv_block=8) ** 2)
+
+        gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        gb = jax.grad(loss_blk, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gd, gb):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       rtol=2e-4, atol=1e-5)
+
+    def test_indivisible_time_rejected(self):
+        q, k, v = self._qkv(T=60)
+        with pytest.raises(ValueError, match="divide"):
+            blockwise_attention(q, k, v, q_block=16, kv_block=16)
+
+    def test_layer_auto_routes_long_sequences(self):
+        """SelfAttentionLayer._pick_block: dense below 2048, blockwise
+        at/above it, explicit block_size honored, -1 forces dense."""
+        layer = SelfAttentionLayer(n_out=16, n_heads=4)
+        assert layer._pick_block(512) == 0
+        assert layer._pick_block(2048) == 512  # 512 preferred (measured)
+        assert layer._pick_block(4096) == 512
+        assert layer._pick_block(2050) == 0  # no dividing block
+        assert SelfAttentionLayer(n_out=16, n_heads=4,
+                                  block_size=256)._pick_block(1024) == 256
+        assert SelfAttentionLayer(n_out=16, n_heads=4,
+                                  block_size=-1)._pick_block(8192) == 0
+
+    def test_layer_blockwise_matches_dense_forward(self):
+        """The layer's blockwise route produces the same activations as
+        the dense route on the same params."""
+        conf = lambda bs: (NeuralNetConfiguration.builder().seed(5)
+                           .updater(Adam(1e-3)).list()
+                           .layer(SelfAttentionLayer(n_out=16, n_heads=4,
+                                                     causal=True,
+                                                     block_size=bs))
+                           .layer(RnnOutputLayer(n_out=3,
+                                                 activation="softmax",
+                                                 loss="mcxent"))
+                           .set_input_type(InputType.recurrent(8))
+                           .build())
+        rng = np.random.default_rng(6)
+        x = rng.standard_normal((2, 64, 8)).astype(np.float32)
+        dense_net = MultiLayerNetwork(conf(-1)).init()
+        blk_net = MultiLayerNetwork(conf(16)).init()
+        ref = dense_net.output(x)
+        out = blk_net.output(x)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
 
 
 class TestRingAttention:
