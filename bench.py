@@ -188,28 +188,34 @@ ATTENTION_TRAIN_FLOPS_PER_TOKEN = 5.72e6   # batch x 512, width 256
 LSTM_TRAIN_FLOPS_PER_TOKEN = 2.02e5        # TextGenerationLSTM geometry
 
 
-def bench_alexnet(batch=256, steps=10, repeats=3, use_pallas=True):
+def bench_alexnet(batch=2048, steps=10, repeats=3, use_pallas=False):
     """zoo AlexNet training img/s/chip — the LRN workload (reference
     zoo/model/AlexNet.java; LRN helper parity
-    CudnnLocalResponseNormalizationHelper.java). Runs with the Pallas
-    LRN kernel by default; `python bench.py alexnet_laxlrn` re-runs with
-    the lax reference LRN so the kernel's contribution is a measured A/B
-    on the full workload, not just the standalone-op 1.9x
-    (ops/pallas_kernels.py)."""
+    CudnnLocalResponseNormalizationHelper.java). Default = the lax LRN
+    (the measured-fastest path); `python bench.py alexnet_pallaslrn`
+    re-runs with the Pallas kernel forced ON so its in-workload cost is
+    a standing measured A/B. Round-5 finding: after fixing the probe
+    bug that had silently kept every traced run on lax, the honest A/B
+    at THIS row's config (batch 2048, bf16, 2026-07-31) shows lax ~3x
+    FASTER (28.2k vs 9.3k img/s; BASELINE.md) — the standalone-op 1.9x
+    never survived fusion+layout reality (docs/perf_googlenet.md)."""
     import jax
     import jax.numpy as jnp
     from deeplearning4j_tpu.models import AlexNet
     from deeplearning4j_tpu.data.dataset import DataSet
 
-    net = AlexNet(num_labels=1000).init(dtype=jnp.float32)
-    if not use_pallas:
+    # bf16 like the resnet50/vgg16/googlenet rows: the workload is
+    # byte-bound (docs/perf_googlenet.md) and halving bytes measured
+    # 21.9k -> 28.8k img/s at b2048 (2026-07-31)
+    net = AlexNet(num_labels=1000).init(dtype=jnp.bfloat16)
+    if use_pallas:
         for layer in net.layers:
             if hasattr(layer, "use_pallas"):
-                layer.use_pallas = False
-        net._build_jitted()  # retrace with the lax LRN path
+                layer.use_pallas = True
+        net._build_jitted()  # retrace with the Pallas LRN path
     rng = np.random.default_rng(0)
     x = jax.device_put(jnp.asarray(
-        rng.standard_normal((batch, 224, 224, 3)), jnp.float32))
+        rng.standard_normal((batch, 224, 224, 3)), jnp.bfloat16))
     y = jax.device_put(
         np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, batch)])
     ds = DataSet(x, y)
@@ -225,10 +231,14 @@ def bench_alexnet(batch=256, steps=10, repeats=3, use_pallas=True):
     return (batch * steps) / dt
 
 
-def bench_googlenet(batch=256, steps=10, repeats=3):
+def bench_googlenet(batch=512, steps=10, repeats=3):
     """zoo GoogLeNet (inception v1) training img/s/chip — the
     ComputationGraph inception-merge + LRN workload (reference
-    zoo/model/GoogLeNet.java:83-180). bf16, fused multi-step loop."""
+    zoo/model/GoogLeNet.java:83-180). bf16, fused multi-step loop.
+    Batch sweep 2026-07-31: 128: 3.8k, 256: 4.2k, 512: 4.3k, 1024:
+    4.3k img/s — 512 is the knee (AlexNet: 256: 14.1k, 512: 17.4k,
+    1024: 18.8k, 2048: 21.9k, 4096 fails to compile through the
+    tunnel; docs/perf_googlenet.md)."""
     import jax
     import jax.numpy as jnp
     from deeplearning4j_tpu.models import GoogLeNet
@@ -599,14 +609,14 @@ def run_once(workload: str, arg):
                 "images/sec",
                 {"est_mfu": _mfu(ips, GOOGLENET_TRAIN_FLOPS_PER_IMAGE)})
     if workload == "alexnet":
-        ips = bench_alexnet(use_pallas=True)
-        return ("alexnet_imagenet_images_per_sec_per_chip", ips,
+        ips = bench_alexnet(use_pallas=False)
+        return ("alexnet_imagenet_bf16_images_per_sec_per_chip", ips,
                 "images/sec",
                 {"est_mfu": _mfu(ips, ALEXNET_TRAIN_FLOPS_PER_IMAGE)})
-    if workload == "alexnet_laxlrn":
-        ips = bench_alexnet(use_pallas=False)
-        return ("alexnet_imagenet_laxlrn_images_per_sec_per_chip", ips,
-                "images/sec",
+    if workload == "alexnet_pallaslrn":
+        ips = bench_alexnet(use_pallas=True)
+        return ("alexnet_imagenet_bf16_pallaslrn_images_per_sec_per_chip",
+                ips, "images/sec",
                 {"est_mfu": _mfu(ips, ALEXNET_TRAIN_FLOPS_PER_IMAGE)})
     if workload == "etl":
         ips = bench_etl()
@@ -628,7 +638,8 @@ def run_once(workload: str, arg):
     raise SystemExit(
         f"Unknown workload {workload!r}; use resnet50 [batch] | vgg16 | "
         "googlenet | attention | attention_longctx [seq] | alexnet | "
-        "alexnet_laxlrn | lenet | lstm | w2v [scale] | etl | lenet_hostfed")
+        "alexnet_pallaslrn | lenet | lstm | w2v [scale] | etl | "
+        "lenet_hostfed")
 
 
 def main():

@@ -511,10 +511,16 @@ class LocalResponseNormalization(Layer):
     def input_kind(self):
         return "cnn"
 
-    # Pallas fast path toggle (the optional-helper contract, reference
-    # ConvolutionLayer.java:66-77): on TPU the fused VMEM kernel runs;
-    # anywhere it cannot, the lax reference path does.
-    use_pallas: bool = True
+    # Pallas kernel toggle (the optional-helper contract, reference
+    # ConvolutionLayer.java:66-77). OFF by default: the round-5
+    # in-workload A/B (bench.py alexnet vs alexnet_pallaslrn, after
+    # fixing the probe bug that had silently disabled the kernel in
+    # every traced run) measured XLA's fused lax chain FASTER than the
+    # VMEM kernel — the pallas_call is a fusion barrier and its
+    # 128-lane channel padding doubles bytes for 64-channel layers
+    # (docs/perf_googlenet.md). The kernel stays available for
+    # channel-heavy geometries where the window pass dominates.
+    use_pallas: bool = False
 
     def forward(self, params, state, x, *, train=False, rng=None, mask=None):
         from ...ops import pallas_kernels as pk

@@ -4,22 +4,30 @@ Role parity: the reference's deeplearning4j-cuda module hand-writes cuDNN
 helpers for ops its default path leaves unfused
 (CudnnLocalResponseNormalizationHelper.java etc., SURVEY.md §2.3). On
 TPU, XLA fuses most of that inventory automatically; Pallas is the
-escape hatch for the residue. LRN is that residue's poster child: the
-cross-channel window turns into a reduce_window + pow + divide chain
-that XLA executes as several HBM round trips, while one Pallas kernel
-keeps the block in VMEM and does squares → shifted-window accumulate →
-pow → divide in a single pass on the VPU. Measured on one v5e chip
-(AlexNet-shaped [64,27,27,96] fp32, 100-op in-jit chain, 2026-07-30):
-633 µs/op Pallas vs 1192 µs/op lax — 1.9× faster.
+escape hatch for the residue. LRN was the candidate: the cross-channel
+window turns into a reduce_window + pow + divide chain, while one
+Pallas kernel keeps the block in VMEM and does squares →
+shifted-window accumulate → pow → divide in a single pass on the VPU.
+
+ROUND-5 HONESTY NOTE: the standalone-op microbench (633 µs/op Pallas vs
+1192 µs/op lax on [64,27,27,96] f32, 2026-07-30) does NOT survive
+in-workload reality. After fixing the probe bug that had silently kept
+every traced run on the lax path (see tpu_kernel_available), the full
+AlexNet A/B measures lax ~2x FASTER end-to-end (bench.py alexnet vs
+alexnet_pallaslrn; docs/perf_googlenet.md): the pallas_call is a
+fusion barrier, and the 128-lane channel padding doubles HBM bytes for
+64-channel LRN layers. The kernels (fwd AND bwd) therefore ship
+default-OFF (LocalResponseNormalization.use_pallas=False) as the
+optional helper the SPI promises, selectable for channel-heavy
+geometries.
 
 Autodiff: pallas_call is not differentiable, so `lrn` carries a
-custom_vjp whose backward differentiates the plain-lax reference
-implementation — the forward takes the fast path, the backward stays
-exactly XLA's gradient (parity-tested against autodiff of the lax
-version).
+custom_vjp; the backward runs the Pallas backward kernel under the same
+gating (else the lax autodiff of the reference implementation) —
+parity-tested against autodiff of the lax version.
 
-The kernel is used when running on TPU (or in interpret mode for CPU
-tests); any failure falls back to the lax implementation, mirroring the
+The kernel path requires TPU (or interpret mode for CPU tests); any
+probe failure falls back to the lax implementation, mirroring the
 reference's "helper != null" optional-acceleration contract
 (ConvolutionLayer.java:66-77).
 """
@@ -48,6 +56,22 @@ def lrn_reference(x, k: float, alpha: float, beta: float, n: int):
     return x / jnp.power(k + alpha * s, beta)
 
 
+def _window_sum(a, up: int, down: int):
+    """Cross-channel windowed sum over the last axis via static shifted
+    slices: out[:, c] = sum(a[:, c-up : c+down+1]) with zero fill.
+    jnp.pad (scalar fill), NOT concatenate-with-zeros: materialized zero
+    blocks become captured constants when the kernel is traced under
+    ensure_compile_time_eval (the probe context), which pallas_call
+    rejects."""
+    acc = a
+    for off in range(1, max(up, down) + 1):
+        if off <= down:  # channel c sees c+off: shift left, zero-fill
+            acc = acc + jnp.pad(a[:, off:], ((0, 0), (0, off)))
+        if off <= up:    # channel c sees c-off: shift right, zero-fill
+            acc = acc + jnp.pad(a[:, :-off], ((0, 0), (off, 0)))
+    return acc
+
+
 def _lrn_kernel(x_ref, o_ref, *, k: float, alpha: float, beta: float,
                 n: int):
     """One [rows, C] block: windowed sum of squares via static shifted
@@ -55,20 +79,31 @@ def _lrn_kernel(x_ref, o_ref, *, k: float, alpha: float, beta: float,
     matches the lax reference's pads (half, n-1-half): channel c sums
     squares over [c-half, c+(n-1-half)]."""
     x = x_ref[:]
-    sq = x * x
     up = n // 2          # channels ABOVE c in the window (c-1..c-up)
     down = n - 1 - up    # channels BELOW c (c+1..c+down)
-    acc = sq
-    for off in range(1, max(up, down) + 1):
-        if off <= down:  # channel c sees c+off: shift left, zero-fill
-            acc = acc + jnp.concatenate(
-                [sq[:, off:], jnp.zeros((sq.shape[0], off), sq.dtype)],
-                axis=1)
-        if off <= up:    # channel c sees c-off: shift right, zero-fill
-            acc = acc + jnp.concatenate(
-                [jnp.zeros((sq.shape[0], off), sq.dtype), sq[:, :-off]],
-                axis=1)
+    acc = _window_sum(x * x, up, down)
     o_ref[:] = x / jnp.power(k + alpha * acc, beta)
+
+
+def _lrn_bwd_kernel(x_ref, g_ref, o_ref, *, k: float, alpha: float,
+                    beta: float, n: int):
+    """LRN backward in one VMEM pass (the lax autodiff of the reference
+    runs this as reduce-window + power + multiply chains over HBM).
+    With d_c = k + alpha * sum_{j in N(c)} x_j^2 and y_c = x_c d_c^-b:
+
+      dx_i = g_i d_i^-b - 2 a b x_i * sum_{c in N*(i)} g_c x_c d_c^(-b-1)
+
+    where N*(i) is the TRANSPOSED window: c in N*(i) iff i in N(c) —
+    i.e. the (up, down) shifts swap."""
+    x = x_ref[:]
+    g = g_ref[:]
+    up = n // 2
+    down = n - 1 - up
+    d = k + alpha * _window_sum(x * x, up, down)
+    p = jnp.power(d, -beta)
+    t = g * x * p / d               # g * x * d^(-beta-1)
+    u = _window_sum(t, down, up)    # transposed window
+    o_ref[:] = g * p - 2.0 * alpha * beta * x * u
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5))
@@ -79,33 +114,46 @@ def lrn(x, k: float = 2.0, alpha: float = 1e-4, beta: float = 0.75,
     return _lrn_pallas(x, k, alpha, beta, n, interpret)
 
 
-def _lrn_pallas(x, k, alpha, beta, n, interpret):
+def _run_lrn_call(kernel, arrays, k, alpha, beta, n, interpret):
+    """Shared pallas_call plumbing for the fwd/bwd LRN kernels: flatten
+    NHWC to [rows, C], lane-align channels, pad rows to the block
+    multiple, grid over row blocks. Zero-padding is exact: padded
+    channels contribute 0 to the window sums of real channels, and
+    padded rows are sliced away."""
     from jax.experimental import pallas as pl
 
-    b, h, w, c = x.shape
+    b, h, w, c = arrays[0].shape
     rows = b * h * w
-    flat = x.reshape(rows, c)
-    # lane-align channels; pad rows to the block multiple
     c_pad = (-c) % 128
     r_pad = (-rows) % _ROW_BLOCK
-    if c_pad or r_pad:
-        flat = jnp.pad(flat, ((0, r_pad), (0, c_pad)))
-    padded_rows, padded_c = flat.shape
-
-    kern = functools.partial(_lrn_kernel, k=float(k), alpha=float(alpha),
+    flats = []
+    for a in arrays:
+        flat = a.reshape(rows, c)
+        if c_pad or r_pad:
+            flat = jnp.pad(flat, ((0, r_pad), (0, c_pad)))
+        flats.append(flat)
+    padded_rows, padded_c = flats[0].shape
+    kern = functools.partial(kernel, k=float(k), alpha=float(alpha),
                              beta=float(beta), n=int(n))
+    spec = pl.BlockSpec((_ROW_BLOCK, padded_c), lambda i: (i, 0))
     out = pl.pallas_call(
         kern,
-        out_shape=jax.ShapeDtypeStruct(flat.shape, flat.dtype),
+        out_shape=jax.ShapeDtypeStruct(flats[0].shape, flats[0].dtype),
         grid=(padded_rows // _ROW_BLOCK,),
-        in_specs=[pl.BlockSpec((_ROW_BLOCK, padded_c),
-                               lambda i: (i, 0))],
-        out_specs=pl.BlockSpec((_ROW_BLOCK, padded_c), lambda i: (i, 0)),
+        in_specs=[spec] * len(flats),
+        out_specs=spec,
         interpret=interpret,
-    )(flat)
-    # NB: zero-padding is exact here: padded channels contribute 0 to the
-    # window sums of real channels, and padded rows are sliced away.
+    )(*flats)
     return out[:rows, :c].reshape(b, h, w, c)
+
+
+def _lrn_pallas(x, k, alpha, beta, n, interpret):
+    return _run_lrn_call(_lrn_kernel, (x,), k, alpha, beta, n, interpret)
+
+
+def _lrn_bwd_pallas(x, g, k, alpha, beta, n, interpret):
+    return _run_lrn_call(_lrn_bwd_kernel, (x, g), k, alpha, beta, n,
+                         interpret)
 
 
 def _lrn_fwd(x, k, alpha, beta, n, interpret):
@@ -113,6 +161,12 @@ def _lrn_fwd(x, k, alpha, beta, n, interpret):
 
 
 def _lrn_bwd(k, alpha, beta, n, interpret, x, g):
+    # The backward kernel is gated exactly like the forward (the round-4
+    # profile showed the lax backward costing ~4x the Pallas forward it
+    # accompanied: reduce-window + power + multiply chains over HBM).
+    if interpret or (lrn_supported(x) and jax.default_backend() == "tpu"
+                     and tpu_kernel_available()):
+        return (_lrn_bwd_pallas(x, g, k, alpha, beta, n, interpret),)
     _, vjp = jax.vjp(lambda v: lrn_reference(v, k, alpha, beta, n), x)
     return vjp(g)
 
@@ -137,12 +191,22 @@ def tpu_kernel_available() -> bool:
     """One-time compile probe. try/except around a traced call CANNOT
     catch Pallas lowering failures (they surface at jit-compile time), so
     the optional-helper fallback is decided here, eagerly, once — the
-    actual 'helper != null' check."""
+    actual 'helper != null' check.
+
+    The probe's first call usually happens while a layer forward is
+    being TRACED (the gating runs inside jit), where a bare jnp.ones
+    would produce a tracer and the probe would throw and cache False —
+    permanently disabling the kernel for the whole process (the round-4
+    GoogLeNet profile caught exactly this: zero Mosaic calls in a
+    "Pallas" run). ensure_compile_time_eval makes the probe eager
+    regardless of any ambient trace."""
     global _probe_result
     if _probe_result is None:
         try:
-            x = jnp.ones((1, 1, 1, 8), jnp.float32)
-            _lrn_pallas(x, 2.0, 1e-4, 0.75, 5, False).block_until_ready()
+            with jax.ensure_compile_time_eval():
+                x = jnp.ones((1, 1, 1, 8), jnp.float32)
+                _lrn_pallas(x, 2.0, 1e-4, 0.75, 5,
+                            False).block_until_ready()
             _probe_result = True
         except Exception as e:
             log.info("Pallas LRN kernel unavailable (%s); lax path", e)

@@ -37,6 +37,21 @@ class TestLrnKernel:
         np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
                                    rtol=1e-4, atol=1e-6)
 
+    @pytest.mark.parametrize("n", [3, 4, 5])
+    @pytest.mark.parametrize("c", [96, 128, 200])
+    def test_backward_kernel_parity(self, n, c):
+        """The dedicated backward kernel == lax autodiff of the
+        reference, including asymmetric (even-n) windows where the
+        transposed window swaps the shift directions."""
+        x = _x(c=c, seed=n)
+        g = _x(c=c, seed=n + 100)
+        _, vjp = jax.vjp(
+            lambda v: pk.lrn_reference(v, 2.0, 1e-4, 0.75, n), x)
+        want = vjp(g)[0]
+        got = pk._lrn_bwd_pallas(x, g, 2.0, 1e-4, 0.75, n, True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=1e-6)
+
     def test_many_rows_gridding(self):
         # rows > _ROW_BLOCK exercises the grid; odd row count pads
         x = _x(b=3, h=11, w=13, c=32, seed=3)
