@@ -24,6 +24,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
+STAGE_AXIS = "stage"
 
 
 def create_mesh(shape: Optional[Sequence[int]] = None,
@@ -76,6 +77,41 @@ def place(arr, sharding: NamedSharding, mesh: Mesh):
     if is_multiprocess(mesh):
         return jax.make_array_from_process_local_data(sharding, np.asarray(arr))
     return jax.device_put(arr, sharding)
+
+
+def place_global(arr, sharding: NamedSharding, mesh: Mesh):
+    """Place a host value that is IDENTICAL on every process, sharded
+    arbitrarily across the global mesh.
+
+    This is the other multiprocess placement contract from `place`:
+    `place` assembles a global array from per-process LOCAL PORTIONS
+    (the DP data-feeding convention), while place_global takes the same
+    full value everywhere and lets each process slice out its
+    addressable shards (make_array_from_callback) — what tensor/
+    sequence parallelism need for params after same-seed init or
+    restore, and for whole batches fed identically to every process.
+    Single-process: plain device_put."""
+    if arr is None:
+        return None
+    if is_multiprocess(mesh):
+        a = np.asarray(arr)
+        return jax.make_array_from_callback(a.shape, sharding,
+                                            lambda idx: a[idx])
+    return jax.device_put(arr, sharding)
+
+
+def gather_replicated(tree, mesh: Mesh):
+    """All-gather a (possibly cross-process-sharded) pytree back to
+    REPLICATED device arrays — jit identity with replicated output
+    shardings, so XLA inserts the all-gathers. COLLECTIVE under a
+    multiprocess mesh: every process must call in lockstep. After this,
+    np.asarray on any leaf is legal (fully addressable), which is what
+    checkpoint serialization needs (ModelSerializer writes host npz)."""
+    if tree is None:
+        return None
+    rep = replicated(mesh)
+    with mesh:
+        return jax.jit(lambda t: t, out_shardings=rep)(tree)
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

@@ -66,10 +66,12 @@ def model_param_spec(arr, model_shards: int) -> P:
 
 
 def shard_params_over_model(tree, mesh: Mesh, model_shards: int):
-    """Place a param/updater pytree under the model_param_spec rule
-    (multiprocess-safe via mesh_lib.place)."""
+    """Place a param/updater pytree under the model_param_spec rule.
+    Multiprocess-safe via mesh_lib.place_global: every process holds the
+    same full values (same-seed init or restore) and contributes its
+    addressable shards — the model axis may span process boundaries."""
     return jax.tree_util.tree_map(
-        lambda a: mesh_lib.place(
+        lambda a: mesh_lib.place_global(
             a, NamedSharding(mesh, model_param_spec(a, model_shards)),
             mesh), tree)
 
@@ -182,13 +184,17 @@ class TensorParallelWrapper:
     def _put_batch(self, a, cast=None):
         """Place one batch-leading array: batch over "data" (floating
         inputs cast to the net dtype); shared by the MLN and graph
-        steps so the placement rule can never diverge between them."""
+        steps so the placement rule can never diverge between them.
+        Multiprocess contract: every process feeds the IDENTICAL global
+        batch (place_global slices each process's shards out of it) —
+        the per-process-partition convention belongs to the DP
+        ParallelWrapper/MultiHostRunner path, not here."""
         if a is None:
             return None
         a = jnp.asarray(a)
         if cast is not None and jnp.issubdtype(a.dtype, jnp.floating):
             a = a.astype(cast)
-        return mesh_lib.place(
+        return mesh_lib.place_global(
             a, NamedSharding(self.mesh, P(self._batch_axis)), self.mesh)
 
     def _run_sharded(self, *packed) -> None:
@@ -221,6 +227,30 @@ class TensorParallelWrapper:
         self._run_sharded(self._put_batch(x, cast=self.model._dtype),
                           self._put_batch(y), self._put_batch(fmask),
                           self._put_batch(lmask))
+
+    def materialize_local(self) -> None:
+        """All-gather the model-sharded params/updater state back to
+        replicated, process-local host arrays, so checkpoint save
+        (ModelSerializer → host npz), single-device inference, or plain
+        net.fit work afterwards. COLLECTIVE under a multiprocess mesh —
+        every process must call in lockstep (the chief-only write
+        happens AFTER this gather; parallel/multihost.py
+        save_checkpoint contract). Training can resume sharded: the
+        next fit_batch re-places (self._placed reset)."""
+        net = self.model
+        net.params_tree = jax.tree_util.tree_map(
+            lambda a: jnp.asarray(np.asarray(a)),
+            mesh_lib.gather_replicated(net.params_tree, self.mesh))
+        net.opt_state = jax.tree_util.tree_map(
+            lambda a: jnp.asarray(np.asarray(a)),
+            mesh_lib.gather_replicated(net.opt_state, self.mesh))
+        net.state_tree = jax.tree_util.tree_map(
+            lambda a: jnp.asarray(np.asarray(a)),
+            mesh_lib.gather_replicated(net.state_tree, self.mesh))
+        net._rng = jnp.asarray(np.asarray(
+            mesh_lib.gather_replicated(net._rng, self.mesh)))
+        self._placed = False
+        self._step = None  # donated buffers were consumed; re-jit
 
     def param_shard_report(self) -> dict:
         """{param_path: partition spec} for every sharded (non-replicated)

@@ -40,6 +40,19 @@ from ..nn.layers.recurrent import RECURRENT_CARRY_KEYS
 log = logging.getLogger(__name__)
 
 
+def repeat_tail_rows(a, pad: int):
+    """Append `pad` copies of the last row (None-safe) — the batch-pad
+    primitive shared by the DP/SP wrappers and their recurrent-carry
+    padding, extracted (like pad_lmask_zero_weight) so the pad rule
+    cannot drift between call sites."""
+    if a is None or pad == 0:
+        return a
+    import jax.numpy as jnp
+    a = jnp.asarray(a)
+    return jnp.concatenate(
+        [a, jnp.broadcast_to(a[-1:], (pad,) + a.shape[1:])], 0)
+
+
 def pad_lmask_zero_weight(lmask, n: int, pad: int):
     """The zero-weight pad-mask contract, shared by ParallelWrapper and
     SequenceParallelWrapper so it cannot drift: a labels mask covering

@@ -135,5 +135,95 @@ if pid == 0:
 ev = runner.evaluate(net, xs, ys, batch_size=16)
 print(f"EVAL {pid} {ev.num_examples()} {ev.accuracy():.6f}", flush=True)
 
+
+def _tree_abs_sum(tree, mesh):
+    """|params| sum over a possibly cross-process-sharded tree: jitted
+    SPMD reduction to a replicated scalar (lockstep on all processes)."""
+    import jax.numpy as jnp
+    total = 0.0
+    with mesh:
+        for leaf in jax.tree_util.tree_leaves(tree):
+            total += float(jax.jit(
+                lambda a: jnp.sum(jnp.abs(a.astype(jnp.float32))))(leaf))
+    return total
+
+
+# Phase 5: TENSOR PARALLELISM across the process boundary (round-5
+# VERDICT item 3: docs/parallelism.md claims "MultiHostRunner around any
+# of the above" — for TP the model axis spans hosts, changing collective
+# routing, so it must be a test, not a claim). Mesh: 1 data x 4 model
+# over 2 processes x 2 devices; both processes feed the IDENTICAL
+# global batch (the place_global contract).
+from deeplearning4j_tpu.parallel import (TensorParallelWrapper,  # noqa: E402
+                                         tensor_parallel_mesh)
+from deeplearning4j_tpu.data.dataset import DataSet  # noqa: E402
+
+tp_net = build_net()
+tp_mesh = tensor_parallel_mesh(model_devices=4, data_devices=1,
+                               devices=jax.devices())
+w = TensorParallelWrapper(tp_net, tp_mesh)
+rng = np.random.default_rng(5)
+tx = rng.standard_normal((16, 8)).astype(np.float32)
+ty = np.eye(3, dtype=np.float32)[rng.integers(0, 3, size=16)]
+for _ in range(3):
+    w.fit_batch(DataSet(tx, ty))
+# sharding evidence: the dense W [8,16] shards (None, "model") and its
+# shards span BOTH processes (addressable < total)
+w0 = tp_net.params_tree[0]["W"]
+spec = tuple(w0.sharding.spec)
+n_total = len(w0.sharding.device_set)
+n_addr = len(w0.addressable_shards)
+print(f"TPSHARD {pid} spec={spec} addr={n_addr}/{n_total}", flush=True)
+print(f"TP {pid} {_tree_abs_sum(tp_net.params_tree, tp_mesh):.6f}",
+      flush=True)
+
+# Phase 5b: checkpoint while TP-placed: collective gather on ALL
+# processes, then the chief-only write + all-process readback.
+w.materialize_local()
+ckpt_tp = os.path.join(tempfile.gettempdir(), f"mh_tp_ckpt_{port}.zip")
+runner.save_checkpoint(tp_net, ckpt_tp)
+re_tp = restore_model(ckpt_tp)
+re_tp_sum = float(sum(np.abs(np.asarray(a)).sum()
+                      for a in jax.tree_util.tree_leaves(
+                          re_tp.params_tree)))
+print(f"TPCKPT {pid} {re_tp_sum:.6f}", flush=True)
+runner.barrier("tp-ckpt-read")
+if pid == 0:
+    os.remove(ckpt_tp)
+
+# Phase 6: SEQUENCE PARALLELISM across the process boundary: time axis
+# sharded 4-way over the 2x2 global device set, ring attention crossing
+# the gloo boundary.
+from deeplearning4j_tpu import RnnOutputLayer, Sgd  # noqa: E402
+from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer  # noqa: E402
+from deeplearning4j_tpu.parallel import (SequenceParallelWrapper,  # noqa: E402
+                                         seq_parallel_mesh)
+
+
+def build_attn():
+    conf = (NeuralNetConfiguration.builder().seed(21)
+            .updater(Sgd(0.1)).list()
+            .layer(SelfAttentionLayer(n_out=16, n_heads=4, causal=True))
+            .layer(RnnOutputLayer(n_out=3, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(InputType.recurrent(8)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+sp_net = build_attn()
+sp_mesh = seq_parallel_mesh(seq_devices=4, devices=jax.devices())
+sw = SequenceParallelWrapper(sp_net, sp_mesh)
+rng = np.random.default_rng(6)
+sx = rng.standard_normal((4, 16, 8)).astype(np.float32)
+sy = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (4, 16))]
+probe = sw._shard_bt(sx, True)  # the [batch, time] placement itself
+print(f"SPSHARD {pid} spec={tuple(probe.sharding.spec)} "
+      f"addr={len(probe.addressable_shards)}/"
+      f"{len(probe.sharding.device_set)}", flush=True)
+for _ in range(2):
+    sw.fit_batch(DataSet(sx, sy))
+print(f"SP {pid} {_tree_abs_sum(sp_net.params_tree, sp_mesh):.6f}",
+      flush=True)
+
 runner.barrier("done")
 print(f"DONE {pid}", flush=True)

@@ -226,3 +226,81 @@ class TestTensorParallel:
         w = TensorParallelWrapper(net, tensor_parallel_mesh())
         w.fit(DataSet(x, y), epochs=2, batch_size=16)
         assert net.epoch == 2
+
+
+class TestTensorParallelCheckpoint:
+    """Round-5 VERDICT item 4: checkpointing under TP-sharded training.
+    Save while placed (the gather), restore, re-place, resume, and
+    match an uninterrupted TP run."""
+
+    def test_save_while_placed_equals_materialized(self, tmp_path):
+        """ModelSerializer.write_model on a model-axis-sharded net
+        gathers correctly: the restored params equal the gathered live
+        ones (single-process: sharded arrays are fully addressable, the
+        host gather happens in np.asarray)."""
+        from deeplearning4j_tpu.utils.model_serializer import (
+            ModelSerializer, restore_model)
+        x, y = _ff_data()
+        net = MultiLayerNetwork(_dense_conf()).init()
+        w = TensorParallelWrapper(net, tensor_parallel_mesh())
+        for _ in range(2):
+            w.fit_batch(DataSet(x, y))
+        assert w.param_shard_report()  # params ARE sharded right now
+        path = str(tmp_path / "tp_placed.zip")
+        ModelSerializer.write_model(net, path)
+        restored = restore_model(path)
+        _assert_params_close(net.params_tree, restored.params_tree,
+                             rtol=0, atol=0)  # gather is exact
+
+    def test_kill_restore_resume_matches_uninterrupted(self, tmp_path):
+        """Train 2 TP steps -> checkpoint -> discard everything
+        ('kill') -> restore -> NEW wrapper re-places -> 1 more step ==
+        3 uninterrupted TP steps, param for param; and the resumed
+        net is genuinely sharded again (report non-empty)."""
+        from deeplearning4j_tpu.utils.model_serializer import (
+            ModelSerializer, restore_model)
+        x, y = _ff_data(seed=4)
+        batches = [DataSet(x[i * 4:(i + 1) * 4], y[i * 4:(i + 1) * 4])
+                   for i in range(3)]
+
+        straight = MultiLayerNetwork(_dense_conf()).init()
+        ws = TensorParallelWrapper(straight, tensor_parallel_mesh())
+        for b in batches:
+            ws.fit_batch(b)
+
+        victim = MultiLayerNetwork(_dense_conf()).init()
+        wv = TensorParallelWrapper(victim, tensor_parallel_mesh())
+        for b in batches[:2]:
+            wv.fit_batch(b)
+        path = str(tmp_path / "tp_resume.zip")
+        ModelSerializer.write_model(victim, path)  # save while placed
+        del victim, wv  # the 'kill'
+
+        resumed = restore_model(path)
+        wr = TensorParallelWrapper(resumed, tensor_parallel_mesh())
+        wr.fit_batch(batches[2])  # re-places then trains
+        assert wr.param_shard_report()  # sharded again after restore
+        assert resumed.iteration == straight.iteration == 3
+        _assert_params_close(straight.params_tree, resumed.params_tree)
+
+    def test_materialize_local_roundtrip_resumes(self):
+        """materialize_local gathers to replicated host arrays (plain
+        net.output works), and continuing through the wrapper re-places
+        and matches an uninterrupted run."""
+        x, y = _ff_data(seed=9)
+        a = MultiLayerNetwork(_dense_conf()).init()
+        wa = TensorParallelWrapper(a, tensor_parallel_mesh())
+        b_ = MultiLayerNetwork(_dense_conf()).init()
+        wb = TensorParallelWrapper(b_, tensor_parallel_mesh())
+        ds = DataSet(x, y)
+        wa.fit_batch(ds)
+        wb.fit_batch(ds)
+        wa.materialize_local()
+        # gathered: process-local single-device arrays; inference works
+        w0 = a.params_tree[0]["W"]
+        assert len(w0.sharding.device_set) == 1
+        out_gathered = a.output(x)
+        wa.fit_batch(ds)  # resumes sharded
+        wb.fit_batch(ds)
+        _assert_params_close(a.params_tree, b_.params_tree)
+        assert out_gathered.shape == (16, 4)
