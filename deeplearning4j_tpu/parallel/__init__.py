@@ -12,6 +12,7 @@ from .mesh import (DATA_AXIS, MODEL_AXIS, SEQ_AXIS, batch_sharded,
 from .param_server import (HttpParameterServerClient, ParameterServer,
                            ParameterServerHttpNode, ParameterServerTrainer,
                            remote_worker_fit)
+from .pipeline import PipelineParallelWrapper, pipeline_mesh
 from .sequence import SequenceParallelWrapper, seq_parallel_mesh
 from .tensor import TensorParallelWrapper, tensor_parallel_mesh
 from .wrapper import ParallelWrapper

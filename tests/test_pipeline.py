@@ -1,0 +1,165 @@
+"""Pipeline parallelism: GPipe-style microbatched stage schedule over
+the mesh "stage" axis == single-device full-batch training
+(parallel/pipeline.py; round-5 VERDICT item 6 — BEYOND-parity scope,
+the reference's only strategy is data parallelism, SURVEY.md §2.4)."""
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (Adam, DataSet, DenseLayer, InputType,
+                                MultiLayerNetwork, NeuralNetConfiguration,
+                                OutputLayer, Sgd)
+from deeplearning4j_tpu.parallel import (PipelineParallelWrapper,
+                                         pipeline_mesh)
+
+
+def _conf(n_body=4, updater=None, l2=0.0, seed=7):
+    b = (NeuralNetConfiguration.builder().seed(seed)
+         .updater(updater or Sgd(0.1)))
+    if l2:
+        b = b.l2(l2)
+    lb = b.list()
+    for _ in range(n_body):
+        lb = lb.layer(DenseLayer(n_in=16, n_out=16, activation="tanh"))
+    return (lb.layer(OutputLayer(n_out=3, activation="softmax",
+                                 loss="mcxent"))
+            .set_input_type(InputType.feed_forward(16))
+            .build())
+
+
+def _data(seed=0, n=16):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, 16)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+    return x, y
+
+
+def _assert_close(a, b, rtol=2e-4, atol=2e-5):
+    for pa, pb in zip(jax.tree_util.tree_leaves(a),
+                      jax.tree_util.tree_leaves(b)):
+        np.testing.assert_allclose(np.asarray(pa), np.asarray(pb),
+                                   rtol=rtol, atol=atol)
+
+
+class TestPipelineParity:
+    @pytest.mark.parametrize("stages,k,M", [(4, 1, 4), (2, 2, 8),
+                                            (8, 1, 2)])
+    def test_fit_matches_single_device(self, stages, k, M):
+        """S stages x k layers/stage x M microbatches: 3 optimizer steps
+        through the GPipe schedule == 3 single-device full-batch steps,
+        param for param (mean-loss recombination is exact for equal
+        microbatches)."""
+        x, y = _data()
+        single = MultiLayerNetwork(_conf(n_body=stages * k)).init()
+        pp_net = MultiLayerNetwork(_conf(n_body=stages * k)).init()
+        w = PipelineParallelWrapper(pp_net, pipeline_mesh(stages),
+                                    n_microbatches=M)
+        ds = DataSet(x, y)
+        for _ in range(3):
+            single._fit_batch(ds)
+            w.fit_batch(ds)
+        assert single.iteration == pp_net.iteration == 3
+        w.materialize_local()
+        _assert_close(single.params_tree, pp_net.params_tree)
+        np.testing.assert_allclose(float(single.score_value),
+                                   float(pp_net.score_value), rtol=1e-4)
+
+    def test_adam_and_l2_match(self):
+        """Stateful elementwise updater (Adam) on the STACKED params +
+        the regularization term both reproduce single-device."""
+        x, y = _data(seed=3)
+        mk = lambda: MultiLayerNetwork(
+            _conf(updater=Adam(1e-2), l2=1e-3)).init()
+        single, pp_net = mk(), mk()
+        w = PipelineParallelWrapper(pp_net, pipeline_mesh(4),
+                                    n_microbatches=4)
+        ds = DataSet(x, y)
+        for _ in range(2):
+            single._fit_batch(ds)
+            w.fit_batch(ds)
+        w.materialize_local()
+        _assert_close(single.params_tree, pp_net.params_tree)
+        _assert_close(single.opt_state, pp_net.opt_state)
+
+    def test_stage_sharding_evidence(self):
+        """Body params genuinely live stage-sharded on the mesh (a
+        replicated run can't fake the parity test)."""
+        net = MultiLayerNetwork(_conf()).init()
+        w = PipelineParallelWrapper(net, pipeline_mesh(4))
+        report = w.stage_shard_report()
+        assert report  # something is sharded
+        assert all(spec[0] == "stage" for spec in report.values())
+        leaf = next(iter(jax.tree_util.tree_leaves(w._body_params)))
+        assert len(leaf.sharding.device_set) == 4
+
+    def test_materialize_then_plain_inference(self):
+        """After materialize_local the net is a normal single-device
+        net: output() and a plain fit step work."""
+        x, y = _data(seed=5)
+        net = MultiLayerNetwork(_conf()).init()
+        w = PipelineParallelWrapper(net, pipeline_mesh(4))
+        w.fit_batch(DataSet(x, y))
+        w.materialize_local()
+        out = net.output(x)
+        assert out.shape == (16, 3)
+        net._fit_batch(DataSet(x, y))  # no stale placement breakage
+
+    def test_epoch_fit_loop(self):
+        x, y = _data(n=32)
+        net = MultiLayerNetwork(_conf()).init()
+        w = PipelineParallelWrapper(net, pipeline_mesh(4),
+                                    n_microbatches=4)
+        w.fit(DataSet(x, y), epochs=2, batch_size=16)
+        assert net.epoch == 2
+        assert net.iteration == 4
+
+
+class TestPipelineValidation:
+    def test_heterogeneous_body_rejected(self):
+        conf = (NeuralNetConfiguration.builder().seed(1).updater(Sgd(0.1))
+                .list()
+                .layer(DenseLayer(n_in=16, n_out=16, activation="tanh"))
+                .layer(DenseLayer(n_in=16, n_out=16, activation="relu"))
+                .layer(DenseLayer(n_in=16, n_out=16, activation="tanh"))
+                .layer(DenseLayer(n_in=16, n_out=16, activation="relu"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(16)).build())
+        net = MultiLayerNetwork(conf).init()
+        with pytest.raises(ValueError, match="IDENTICAL"):
+            PipelineParallelWrapper(net, pipeline_mesh(4))
+
+    def test_indivisible_stages_rejected(self):
+        net = MultiLayerNetwork(_conf(n_body=3)).init()
+        with pytest.raises(ValueError, match="divide"):
+            PipelineParallelWrapper(net, pipeline_mesh(4))
+
+    def test_dropout_rejected(self):
+        conf = (NeuralNetConfiguration.builder().seed(1).updater(Sgd(0.1))
+                .list()
+                .layer(DenseLayer(n_in=16, n_out=16, activation="tanh",
+                                  dropout_rate=0.5))
+                .layer(DenseLayer(n_in=16, n_out=16, activation="tanh",
+                                  dropout_rate=0.5))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(16)).build())
+        net = MultiLayerNetwork(conf).init()
+        with pytest.raises(ValueError, match="dropout"):
+            PipelineParallelWrapper(net, pipeline_mesh(2))
+
+    def test_indivisible_microbatches_rejected(self):
+        x, y = _data(n=10)
+        net = MultiLayerNetwork(_conf()).init()
+        w = PipelineParallelWrapper(net, pipeline_mesh(4),
+                                    n_microbatches=4)
+        with pytest.raises(ValueError, match="microbatch"):
+            w.fit_batch(DataSet(x, y))
+
+    def test_masks_rejected(self):
+        x, y = _data()
+        net = MultiLayerNetwork(_conf()).init()
+        w = PipelineParallelWrapper(net, pipeline_mesh(4))
+        ds = DataSet(x, y, labels_mask=np.ones((16, 1), np.float32))
+        with pytest.raises(NotImplementedError, match="mask"):
+            w.fit_batch(ds)
