@@ -217,18 +217,32 @@ class PipelineParallelWrapper:
                 + _regularization_score([out_layer], [out_p])
             return loss + reg
 
+        from ..nn.updaters import normalize_layer_gradients
+
         def step(body_p, body_o, out_p, out_o, iteration, x_mb, y_mb):
             loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1))(
                 body_p, out_p, x_mb, y_mb)
             g_body, g_out = grads
-            upd_b, new_bo = template.updater.update(g_body, body_o,
-                                                    iteration)
-            new_bp = jax.tree_util.tree_map(
-                lambda p, u: p - u.astype(p.dtype), body_p, upd_b)
-            upd_o, new_oo = out_layer.updater.update(g_out, out_o,
-                                                     iteration)
-            new_op = jax.tree_util.tree_map(
-                lambda p, u: p - u.astype(p.dtype), out_p, upd_o)
+            if template.frozen:  # transfer-learning freeze honored
+                new_bp, new_bo = body_p, body_o
+            else:
+                upd_b, new_bo = template.updater.update(g_body, body_o,
+                                                        iteration)
+                new_bp = jax.tree_util.tree_map(
+                    lambda p, u: p - u.astype(p.dtype), body_p, upd_b)
+            if out_layer.frozen:
+                new_op, new_oo = out_p, out_o
+            else:
+                # per-layer normalization is fine on the (unstacked)
+                # output layer — only BODY layers reject it (stacking
+                # would mix stages in one norm)
+                g_out = normalize_layer_gradients(
+                    g_out, out_layer.gradient_normalization,
+                    out_layer.gradient_normalization_threshold)
+                upd_o, new_oo = out_layer.updater.update(g_out, out_o,
+                                                         iteration)
+                new_op = jax.tree_util.tree_map(
+                    lambda p, u: p - u.astype(p.dtype), out_p, upd_o)
             return new_bp, new_bo, new_op, new_oo, iteration + 1, loss
 
         sh = lambda t: jax.tree_util.tree_map(lambda a: a.sharding, t)

@@ -1,5 +1,7 @@
 """Streaming: NDArray pub/sub + model-serving routes (reference
 dl4j-streaming: Kafka NDArrayPublisher/NDArrayConsumer + Camel
 DL4jServeRouteBuilder, SURVEY.md §2.4)."""
-from .ndarray_stream import (NDArrayConsumer, NDArrayPublisher,
-                             NDArrayStreamServer, NDArrayTopic, ServeRoute)
+from .ndarray_stream import (Broker, HttpBrokerClient, InProcessBroker,
+                             NDArrayConsumer, NDArrayPublisher,
+                             NDArrayStreamServer, NDArrayTopic, ServeRoute,
+                             get_default_broker, set_default_broker)

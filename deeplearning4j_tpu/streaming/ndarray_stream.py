@@ -64,7 +64,26 @@ class NDArrayTopic:
                 pass  # slow consumer drops, publisher never blocks
 
 
-class _Broker:
+class Broker:
+    """The pluggable transport seam (round 5; the reference swaps
+    brokers at the Camel/Kafka component level —
+    kafka/NDArrayKafkaClient.java:10). An implementation maps topic
+    names to objects with the NDArrayTopic surface: `publish(arr)`,
+    `subscribe() -> queue.Queue`, `unsubscribe(q)`. Publishers,
+    consumers, and serve routes are broker-agnostic; an external-system
+    adapter (Kafka, Pub/Sub, ...) implements `topic` with a consumer
+    thread feeding the returned queue. Ships: InProcessBroker (default)
+    and HttpBrokerClient (a remote NDArrayStreamServer)."""
+
+    def topic(self, name: str):
+        raise NotImplementedError
+
+
+class InProcessBroker(Broker):
+    """Topics live in this process (the single-JVM embedded-broker
+    role); NDArrayStreamServer exposes the SAME broker over HTTP for
+    cross-process use."""
+
     def __init__(self):
         self._topics: Dict[str, NDArrayTopic] = {}
         self._lock = threading.Lock()
@@ -77,13 +96,125 @@ class _Broker:
             return t
 
 
-_default_broker = _Broker()
+_Broker = InProcessBroker  # back-compat alias
+_default_broker: Broker = InProcessBroker()
+
+
+def get_default_broker() -> Broker:
+    return _default_broker
+
+
+def set_default_broker(broker: Broker) -> Broker:
+    """Swap the process-wide default transport (e.g. to an external
+    adapter); returns the previous broker so callers can restore it."""
+    global _default_broker
+    prev = _default_broker
+    _default_broker = broker
+    return prev
+
+
+class _HttpTopic:
+    """Client-side topic over a remote NDArrayStreamServer: publish
+    POSTs; subscribe long-polls /consume on a daemon thread into a
+    local queue (the consumer-thread pattern an external-broker adapter
+    uses too)."""
+
+    def __init__(self, base_url: str, name: str, client_id: str,
+                 poll_timeout: float):
+        self._url = base_url.rstrip("/")
+        self.name = name
+        self._client_id = client_id
+        self._poll_timeout = poll_timeout
+        self._pollers: List[tuple] = []  # (queue, stop_event, thread)
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def _post(self, route: str, payload: dict) -> dict:
+        import json
+        import urllib.request
+        req = urllib.request.Request(
+            self._url + route, json.dumps(payload).encode(),
+            {"Content-Type": "application/json"})
+        with urllib.request.urlopen(
+                req, timeout=self._poll_timeout + 10) as resp:
+            return json.loads(resp.read())
+
+    def publish(self, arr) -> None:
+        self._post("/publish", {"topic": self.name,
+                                **_encode(np.asarray(arr, np.float32))})
+
+    def subscribe(self) -> "queue.Queue":
+        q: "queue.Queue" = queue.Queue(maxsize=256)
+        stop = threading.Event()
+        with self._lock:  # unique client id under concurrent subscribes
+            self._n += 1
+            client = f"{self._client_id}-{self._n}"
+        # Register the server-side subscription SYNCHRONOUSLY (a
+        # zero-wait consume) so subscribe-then-publish cannot lose the
+        # first message to the poller's startup window — the
+        # InProcessBroker ordering guarantee holds over HTTP too.
+        self._post("/consume", {"topic": self.name, "client": client,
+                                "timeout": 0.0})
+
+        def run():
+            while not stop.is_set():
+                try:
+                    out = self._post("/consume", {
+                        "topic": self.name, "client": client,
+                        "timeout": self._poll_timeout})
+                except Exception:
+                    if stop.wait(0.2):
+                        return
+                    continue
+                if not out.get("empty", True):
+                    try:
+                        q.put_nowait(_decode(out))
+                    except queue.Full:
+                        pass  # slow consumer drops, like NDArrayTopic
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        with self._lock:
+            self._pollers.append((q, stop, t))
+        return q
+
+    def unsubscribe(self, q: "queue.Queue") -> None:
+        with self._lock:
+            ents = [e for e in self._pollers if e[0] is q]
+            for ent in ents:
+                self._pollers.remove(ent)
+        for ent in ents:
+            ent[1].set()
+
+
+class HttpBrokerClient(Broker):
+    """Broker over a remote NDArrayStreamServer — the cross-process
+    transport as a first-class Broker implementation (so a serve route
+    can consume from one machine's topics and publish to another's)."""
+
+    def __init__(self, base_url: str, client_id: Optional[str] = None,
+                 poll_timeout: float = 2.0):
+        import uuid
+        self._base_url = base_url
+        self._client_id = client_id or uuid.uuid4().hex[:8]
+        self._poll_timeout = float(poll_timeout)
+        self._topics: Dict[str, _HttpTopic] = {}
+        self._lock = threading.Lock()
+
+    def topic(self, name: str) -> _HttpTopic:
+        with self._lock:
+            t = self._topics.get(name)
+            if t is None:
+                t = self._topics[name] = _HttpTopic(
+                    self._base_url, name, self._client_id,
+                    self._poll_timeout)
+            return t
 
 
 class NDArrayPublisher:
     """Reference kafka/NDArrayPublisher: publish(arr) onto a topic."""
 
-    def __init__(self, topic: str, broker: Optional[_Broker] = None):
+    def __init__(self, topic: str, broker: Optional[Broker] = None):
         self._topic = (broker or _default_broker).topic(topic)
 
     def publish(self, arr) -> None:
@@ -93,7 +224,7 @@ class NDArrayPublisher:
 class NDArrayConsumer:
     """Reference kafka/NDArrayConsumer: blocking getArrays()."""
 
-    def __init__(self, topic: str, broker: Optional[_Broker] = None):
+    def __init__(self, topic: str, broker: Optional[Broker] = None):
         self._queue = (broker or _default_broker).topic(topic).subscribe()
 
     def get(self, timeout: Optional[float] = None) -> np.ndarray:
@@ -112,7 +243,7 @@ class ServeRoute:
     `output_topic` — on a background thread until stop()."""
 
     def __init__(self, model, input_topic: str, output_topic: str,
-                 broker: Optional[_Broker] = None):
+                 broker: Optional[Broker] = None):
         self.model = model
         self._consumer = NDArrayConsumer(input_topic, broker)
         self._publisher = NDArrayPublisher(output_topic, broker)
@@ -159,7 +290,7 @@ class NDArrayStreamServer(JsonHttpServer):
     POST /consume {topic, timeout} (long-poll; registers the caller's
     subscription on first consume)."""
 
-    def __init__(self, port: int = 0, broker: Optional[_Broker] = None,
+    def __init__(self, port: int = 0, broker: Optional[Broker] = None,
                  subscriber_idle_ttl: float = 300.0):
         super().__init__(get_routes={"/health": self._health},
                          post_routes={"/publish": self._publish,

@@ -104,6 +104,33 @@ class TestPipelineParity:
         assert out.shape == (16, 3)
         net._fit_batch(DataSet(x, y))  # no stale placement breakage
 
+    def test_frozen_layers_not_trained(self):
+        """Transfer-learning freeze is honored: frozen body layers keep
+        their params bit-for-bit while the output layer trains (the
+        single-device train_step contract, multilayer.py:175)."""
+        conf = (NeuralNetConfiguration.builder().seed(8).updater(Sgd(0.1))
+                .list()
+                .layer(DenseLayer(n_in=16, n_out=16, activation="tanh",
+                                  frozen=True))
+                .layer(DenseLayer(n_in=16, n_out=16, activation="tanh",
+                                  frozen=True))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(16)).build())
+        x, y = _data(seed=7)
+        net = MultiLayerNetwork(conf).init()
+        before = [jax.tree_util.tree_map(np.asarray, p)
+                  for p in net.params_tree]
+        w = PipelineParallelWrapper(net, pipeline_mesh(2))
+        w.fit_batch(DataSet(x, y))
+        w.materialize_local()
+        for b, a in zip(before[:2], net.params_tree[:2]):
+            for k in b:
+                np.testing.assert_array_equal(b[k], np.asarray(a[k]),
+                                              err_msg=k)
+        assert not np.array_equal(before[-1]["W"],
+                                  np.asarray(net.params_tree[-1]["W"]))
+
     def test_epoch_fit_loop(self):
         x, y = _data(n=32)
         net = MultiLayerNetwork(_conf()).init()
