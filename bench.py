@@ -660,13 +660,49 @@ def main():
     # "regression" was exactly this kind of run-to-run drift, with no
     # spread recorded to prove it).
     repeats = int(os.environ.get("BENCH_REPEATS", "3"))
+    # Total wall budget: per-child compiles through the tunnel can run
+    # minutes, and the driver's bench invocation must not time out.
+    # Stop early (reporting the actual n) rather than blow the budget —
+    # the spread instrumentation degrades gracefully instead of the
+    # whole round's BENCH artifact failing.
+    budget = float(os.environ.get("BENCH_TIME_BUDGET_S", "420"))
+    child_env = dict(os.environ)
+    # Children share a persistent compile cache when the backend
+    # supports one — repeats then measure run variance, not recompiles.
+    child_env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                         "/tmp/dl4jtpu_bench_jaxcache")
     sent_pre = host_sentinel_ms()
     runs = []
-    for _ in range(repeats):
-        out = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), *argv, "--once"],
-            capture_output=True, text=True, cwd=os.path.dirname(
-                os.path.abspath(__file__)) or ".")
+    t_start = time.perf_counter()
+    for i in range(repeats):
+        elapsed = time.perf_counter() - t_start
+        per_child = elapsed / max(1, len(runs)) if runs else 0.0
+        if runs and elapsed + per_child > budget:
+            sys.stderr.write(
+                f"bench: stopping after {len(runs)} repeats "
+                f"({elapsed:.0f}s elapsed, budget {budget:.0f}s)\n")
+            break
+        # hard per-child wall limit: a hung tunnel compile must not
+        # blow the budget between checks (the child gets whatever
+        # budget remains, never less than 120s so the first child can
+        # always compile)
+        child_limit = max(budget - elapsed, 120.0)
+        try:
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), *argv,
+                 "--once"],
+                capture_output=True, text=True, env=child_env,
+                timeout=child_limit,
+                cwd=os.path.dirname(os.path.abspath(__file__)) or ".")
+        except subprocess.TimeoutExpired:
+            if runs:  # keep what we have; report the smaller n
+                sys.stderr.write(
+                    f"bench: child {i} exceeded {child_limit:.0f}s; "
+                    f"reporting {len(runs)} repeats\n")
+                break
+            raise SystemExit(
+                f"bench subprocess exceeded {child_limit:.0f}s with no "
+                f"completed repeat")
         lines = out.stdout.strip().splitlines()
         if out.returncode != 0 or not lines:
             sys.stderr.write(out.stderr[-2000:])
@@ -674,6 +710,7 @@ def main():
                 f"bench subprocess failed (rc={out.returncode}, "
                 f"{len(lines)} stdout lines)")
         runs.append(json.loads(lines[-1]))
+    repeats = len(runs)
     # bracket the measurement window: the sentinel is re-sampled AFTER
     # the (minutes-long) repeats so contention arising mid-measurement
     # shows up; report the WORST bracket

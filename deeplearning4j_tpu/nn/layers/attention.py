@@ -154,10 +154,15 @@ class SelfAttentionLayer(Layer):
                         h, int(mesh.shape[head_axis]), head_axis)
                     SelfAttentionLayer._warned_head_fallback = True
                 head_axis = None
+            # compose blockwise INSIDE the ring when the PER-DEVICE
+            # slice is itself long (same policy as the single-device
+            # path): live memory O(t_loc x block), not [t_loc, t_loc]
             out = ring_self_attention(q, k, v, mesh, axis=seq_axis,
                                       causal=self.causal, key_mask=mask,
                                       batch_axis=batch_axis,
-                                      head_axis=head_axis)
+                                      head_axis=head_axis,
+                                      block_size=self._pick_block(
+                                          t // seq_shards))
         else:
             blk = self._pick_block(t)
             if blk:

@@ -173,8 +173,14 @@ def blockwise_attention(q, k, v, *, causal: bool = False,
     return jnp.concatenate(outs, axis=1).astype(q.dtype)
 
 
-def _ring_body(axis: str, n_dev: int, t_loc: int, causal: bool):
-    """Per-device ring loop (runs inside shard_map)."""
+def _ring_body(axis: str, n_dev: int, t_loc: int, causal: bool,
+               block_size: int = 0):
+    """Per-device ring loop (runs inside shard_map). With block_size > 0
+    (dividing t_loc), each hop's K/V block is consumed in blockwise
+    sub-blocks through a checkpointed scan — the single-device
+    blockwise_attention recipe composed INSIDE the ring, so per-device
+    live memory is O(t_loc x block) instead of the [t_loc, t_loc] score
+    matrix, and long-per-device sequences stay trainable."""
 
     def fn(q, k, v, key_mask):
         # q/k/v local blocks [b, t_loc, h, d]; key_mask [b, t_loc] or None
@@ -188,18 +194,16 @@ def _ring_body(axis: str, n_dev: int, t_loc: int, causal: bool):
         o = jnp.zeros((b, h, t_loc, q.shape[-1]), acc)
         q_pos = my * t_loc + jnp.arange(t_loc)
 
-        def step(s, carry):
-            m, l, o, k_blk, v_blk, km_blk = carry
-            src = (my - s) % n_dev  # which device's block we now hold
+        def online_update(m, l, o, k_sub, v_sub, km_sub, kv_pos):
+            """One K/V sub-block folded into the (m, l, o) running
+            softmax state — the shared flash/ring accumulation."""
             scores = jnp.einsum("bqhd,bkhd->bhqk", qf,
-                                k_blk.astype(acc))
-            valid = jnp.ones((t_loc, t_loc), bool)
+                                k_sub.astype(acc))
             if causal:
-                kv_pos = src * t_loc + jnp.arange(t_loc)
                 valid = kv_pos[None, :] <= q_pos[:, None]
-            scores = jnp.where(valid[None, None], scores, NEG)
-            if km_blk is not None:
-                scores = jnp.where(km_blk[:, None, None, :] > 0, scores,
+                scores = jnp.where(valid[None, None], scores, NEG)
+            if km_sub is not None:
+                scores = jnp.where(km_sub[:, None, None, :] > 0, scores,
                                    NEG)
             s_max = scores.max(-1)
             new_m = jnp.maximum(m, s_max)
@@ -213,14 +217,48 @@ def _ring_body(axis: str, n_dev: int, t_loc: int, causal: bool):
                           jnp.zeros_like(p), p)
             l = l * corr + p.sum(-1)
             o = o * corr[..., None] + jnp.einsum(
-                "bhqk,bkhd->bhqd", p, v_blk.astype(acc))
+                "bhqk,bkhd->bhqd", p, v_sub.astype(acc))
+            return new_m, l, o
+
+        def step(s, carry):
+            m, l, o, k_blk, v_blk, km_blk = carry
+            src = (my - s) % n_dev  # which device's block we now hold
+            kv_pos0 = src * t_loc
+            if block_size and block_size < t_loc:
+                nb = t_loc // block_size
+                kb = k_blk.reshape(b, nb, block_size, h, d)
+                vb = v_blk.reshape(b, nb, block_size, h, d)
+                kmb = None if km_blk is None else \
+                    km_blk.reshape(b, nb, block_size)
+
+                @jax.checkpoint
+                def sub(carry, xs):
+                    mm, ll, oo = carry
+                    if kmb is None:
+                        k_s, v_s, j = xs
+                        km_s = None
+                    else:
+                        k_s, v_s, km_s, j = xs
+                    kv_pos = kv_pos0 + j * block_size + \
+                        jnp.arange(block_size)
+                    return online_update(mm, ll, oo, k_s, v_s, km_s,
+                                         kv_pos), None
+
+                xs = (jnp.swapaxes(kb, 0, 1), jnp.swapaxes(vb, 0, 1)) \
+                    + (() if kmb is None else (jnp.swapaxes(kmb, 0, 1),)) \
+                    + (jnp.arange(nb),)
+                (m, l, o), _ = jax.lax.scan(sub, (m, l, o), xs)
+            else:
+                m, l, o = online_update(
+                    m, l, o, k_blk, v_blk, km_blk,
+                    kv_pos0 + jnp.arange(t_loc))
             if s < n_dev - 1:  # the last block is never needed again
                 perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
                 k_blk = jax.lax.ppermute(k_blk, axis, perm)
                 v_blk = jax.lax.ppermute(v_blk, axis, perm)
                 if km_blk is not None:
                     km_blk = jax.lax.ppermute(km_blk, axis, perm)
-            return new_m, l, o, k_blk, v_blk, km_blk
+            return m, l, o, k_blk, v_blk, km_blk
 
         carry = (m, l, o, k, v, key_mask)
         # n_dev is static: unrolled python loop keeps ppermute schedules
@@ -238,7 +276,8 @@ def ring_self_attention(q, k, v, mesh, *, axis: str = "seq",
                         causal: bool = False,
                         key_mask: Optional[jax.Array] = None,
                         batch_axis: Optional[str] = None,
-                        head_axis: Optional[str] = None) -> jax.Array:
+                        head_axis: Optional[str] = None,
+                        block_size: int = 0) -> jax.Array:
     """Sequence-parallel attention: q/k/v [batch, time, heads, head_dim]
     with TIME sharded over `axis` of `mesh` (and, optionally, BATCH
     sharded over `batch_axis` — the DP x SP layout — and HEADS over
@@ -257,7 +296,11 @@ def ring_self_attention(q, k, v, mesh, *, axis: str = "seq",
         raise ValueError(
             f"heads {q.shape[2]} must divide the "
             f"{int(mesh.shape[head_axis])}-device '{head_axis}' mesh axis")
-    body = _ring_body(axis, n_dev, t // n_dev, causal)
+    if block_size and (t // n_dev) % block_size:
+        raise ValueError(
+            f"per-device time {t // n_dev} must divide "
+            f"block_size={block_size}")
+    body = _ring_body(axis, n_dev, t // n_dev, causal, block_size)
     spec_qkv = P(batch_axis, axis, head_axis, None)
     if key_mask is None:
         fn = jax.shard_map(lambda a, b, c: body(a, b, c, None), mesh=mesh,

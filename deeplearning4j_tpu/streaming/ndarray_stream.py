@@ -156,13 +156,21 @@ class _HttpTopic:
         self._post("/consume", {"topic": self.name, "client": client,
                                 "timeout": 0.0})
 
+        warned = [False]
+
         def run():
             while not stop.is_set():
                 try:
                     out = self._post("/consume", {
                         "topic": self.name, "client": client,
                         "timeout": self._poll_timeout})
-                except Exception:
+                except Exception as e:
+                    if not warned[0]:  # visible, once (dead transport)
+                        import logging
+                        logging.getLogger(__name__).warning(
+                            "HTTP broker poll of %s/%s failing (%s); "
+                            "retrying", self._url, self.name, e)
+                        warned[0] = True
                     if stop.wait(0.2):
                         return
                     continue
@@ -175,7 +183,7 @@ class _HttpTopic:
         t = threading.Thread(target=run, daemon=True)
         t.start()
         with self._lock:
-            self._pollers.append((q, stop, t))
+            self._pollers.append((q, stop, t, client))
         return q
 
     def unsubscribe(self, q: "queue.Queue") -> None:
@@ -185,6 +193,14 @@ class _HttpTopic:
                 self._pollers.remove(ent)
         for ent in ents:
             ent[1].set()
+            try:
+                # release the server-side queue promptly (otherwise it
+                # keeps fanning publishes into a dead subscription until
+                # another client's idle sweep evicts it)
+                self._post("/unsubscribe", {"topic": self.name,
+                                            "client": ent[3]})
+            except Exception:
+                pass  # server gone: its consumer map died with it
 
 
 class HttpBrokerClient(Broker):
@@ -294,7 +310,9 @@ class NDArrayStreamServer(JsonHttpServer):
                  subscriber_idle_ttl: float = 300.0):
         super().__init__(get_routes={"/health": self._health},
                          post_routes={"/publish": self._publish,
-                                      "/consume": self._consume}, port=port)
+                                      "/consume": self._consume,
+                                      "/unsubscribe": self._unsubscribe},
+                         port=port)
         # Default to the SHARED broker so in-process publishers/consumers
         # and remote HTTP clients see the same topics.
         self._broker = broker or _default_broker
@@ -310,6 +328,16 @@ class NDArrayStreamServer(JsonHttpServer):
     def _publish(self, req: dict):
         self._broker.topic(req["topic"]).publish(_decode(req))
         return 200, {"ok": True}
+
+    def _unsubscribe(self, req: dict):
+        """Prompt release of a remote client's subscription (the idle
+        TTL sweep is only the departed-without-goodbye fallback)."""
+        key = (req["topic"], str(req.get("client", "default")))
+        with self._lock:
+            ent = self._consumers.pop(key, None)
+        if ent is not None:
+            self._broker.topic(key[0]).unsubscribe(ent[0])
+        return 200, {"ok": ent is not None}
 
     def _consume(self, req: dict):
         import time
