@@ -654,11 +654,12 @@ def main():
                           "unit": unit, **extra}))
         return
 
-    # Process-level repeats: each child pays compile + placement + run
-    # in a FRESH process, so the reported spread covers everything a
-    # round-over-round comparison covers (the round-4 6852-vs-7014
-    # "regression" was exactly this kind of run-to-run drift, with no
-    # spread recorded to prove it).
+    # Process-level repeats in FRESH processes. With the shared compile
+    # cache below, the FIRST child pays compile and later children
+    # measure run/placement variance (on backends without a persistent
+    # cache every child pays compile, and the spread covers that too).
+    # Motivation either way: the round-4 6852-vs-7014 "regression" was
+    # run-to-run drift with no spread recorded to prove it.
     repeats = int(os.environ.get("BENCH_REPEATS", "3"))
     # Total wall budget: per-child compiles through the tunnel can run
     # minutes, and the driver's bench invocation must not time out.

@@ -66,11 +66,15 @@ PEEP_G = "wG"
 
 
 def _scan_rnn(cell, x, h0, c0, mask, reverse=False):
-    """Run `cell(xt, h, c) -> (h', c')` over the time axis of [B, T, F] data.
+    """Run `cell(zxt, h, c) -> (h', c')` over the time axis of
+    PRE-PROJECTED [B, T, 4H] inputs (see LSTM._input_proj: the
+    time-independent x @ W + b is hoisted out of the scan into ONE
+    batched MXU matmul — the cuDNN-LSTM input-projection trick — so the
+    sequential body only computes the h @ RW recurrence).
 
     Outputs are aligned to input time positions for both directions (lax.scan
     reverse=True). Mask [B, T] zeroes h and c at masked steps."""
-    xT = jnp.swapaxes(x, 0, 1)  # [T, B, F]
+    xT = jnp.swapaxes(x, 0, 1)  # [T, B, 4H]
     if mask is not None:
         mT = jnp.swapaxes(mask.astype(h0.dtype), 0, 1)[..., None]  # [T, B, 1]
 
@@ -152,20 +156,25 @@ class LSTM(Layer):
         return (0.0, 0.0)
 
     # -- math --------------------------------------------------------------
+    def _input_proj(self, params, x, prefix=""):
+        """Time-independent half of the gate pre-activations for ALL
+        timesteps in one [B*T, n_in] @ [n_in, 4H] matmul (plus bias):
+        hoisted out of the scan so the MXU sees one large contraction
+        instead of T small ones."""
+        return x @ params[prefix + WEIGHT] + params[prefix + BIAS]
+
     def _cell(self, params, prefix=""):
         H = self.n_out
         act = self._act()
         gate = act_ops.resolve(self.gate_activation)
-        W = params[prefix + WEIGHT]
         RW = params[prefix + RECURRENT_WEIGHT]
-        b = params[prefix + BIAS]
         peep = self._has_peepholes()
         if peep:
             wF, wO, wG = (params[prefix + PEEP_F], params[prefix + PEEP_O],
                           params[prefix + PEEP_G])
 
-        def cell(xt, h, c):
-            z = xt @ W + h @ RW + b  # [B, 4H], gate order [i, f, o, g]
+        def cell(zxt, h, c):
+            z = zxt + h @ RW  # [B, 4H], gate order [i, f, o, g]
             zi, zf, zo, zg = (z[:, :H], z[:, H:2 * H], z[:, 2 * H:3 * H],
                               z[:, 3 * H:])
             i = act(zi)  # candidate: LAYER activation (LSTMHelpers:194)
@@ -205,7 +214,8 @@ class LSTM(Layer):
             h0, c0 = state["h"].astype(carry_dt), state["c"].astype(carry_dt)
         else:
             h0, c0 = self._zeros_state(x.shape[0], carry_dt)
-        ys, hT, cT = _scan_rnn(self._cell(params), x, h0, c0, mask)
+        ys, hT, cT = _scan_rnn(self._cell(params),
+                               self._input_proj(params, x), h0, c0, mask)
         new_state = {"h": hT, "c": cT} if stateful else state
         if single_step:
             ys = ys[:, 0, :]
@@ -251,9 +261,12 @@ class GravesBidirectionalLSTM(GravesLSTM):
         x = dropout(x, self.dropout_rate, train, rng)
         carry_dt = jnp.result_type(x.dtype, params["F" + WEIGHT].dtype)
         h0, c0 = self._zeros_state(x.shape[0], carry_dt)
-        fwd, _, _ = _scan_rnn(self._cell(params, "F"), x, h0, c0, mask)
-        bwd, _, _ = _scan_rnn(self._cell(params, "B"), x, h0, c0, mask,
-                              reverse=True)
+        fwd, _, _ = _scan_rnn(self._cell(params, "F"),
+                              self._input_proj(params, x, "F"), h0, c0,
+                              mask)
+        bwd, _, _ = _scan_rnn(self._cell(params, "B"),
+                              self._input_proj(params, x, "B"), h0, c0,
+                              mask, reverse=True)
         return fwd + bwd, state
 
 

@@ -159,48 +159,54 @@ class _HttpTopic:
         warned = [False]
 
         def run():
-            while not stop.is_set():
-                try:
-                    out = self._post("/consume", {
-                        "topic": self.name, "client": client,
-                        "timeout": self._poll_timeout})
-                except Exception as e:
-                    if not warned[0]:  # visible, once (dead transport)
-                        import logging
-                        logging.getLogger(__name__).warning(
-                            "HTTP broker poll of %s/%s failing (%s); "
-                            "retrying", self._url, self.name, e)
-                        warned[0] = True
-                    if stop.wait(0.2):
-                        return
-                    continue
-                if not out.get("empty", True):
+            try:
+                while not stop.is_set():
                     try:
-                        q.put_nowait(_decode(out))
-                    except queue.Full:
-                        pass  # slow consumer drops, like NDArrayTopic
+                        out = self._post("/consume", {
+                            "topic": self.name, "client": client,
+                            "timeout": self._poll_timeout})
+                    except Exception as e:
+                        if not warned[0]:  # visible, once (dead server)
+                            import logging
+                            logging.getLogger(__name__).warning(
+                                "HTTP broker poll of %s/%s failing (%s); "
+                                "retrying", self._url, self.name, e)
+                            warned[0] = True
+                        if stop.wait(0.2):
+                            return
+                        continue
+                    if not out.get("empty", True):
+                        try:
+                            q.put_nowait(_decode(out))
+                        except queue.Full:
+                            pass  # slow consumer drops, like NDArrayTopic
+            finally:
+                # the POLLER posts the goodbye, strictly AFTER its last
+                # /consume — an unsubscribe posted from another thread
+                # could be overtaken by an in-flight consume that
+                # re-registers the queue server-side
+                try:
+                    self._post("/unsubscribe", {"topic": self.name,
+                                                "client": client})
+                except Exception:
+                    pass  # server gone: its consumer map died with it
 
         t = threading.Thread(target=run, daemon=True)
         t.start()
         with self._lock:
-            self._pollers.append((q, stop, t, client))
+            self._pollers.append((q, stop, t))
         return q
 
     def unsubscribe(self, q: "queue.Queue") -> None:
+        """Stops the poller; the poller itself then releases the
+        server-side queue (see run()'s finally) so publishes stop
+        fanning into a dead subscription."""
         with self._lock:
             ents = [e for e in self._pollers if e[0] is q]
             for ent in ents:
                 self._pollers.remove(ent)
         for ent in ents:
             ent[1].set()
-            try:
-                # release the server-side queue promptly (otherwise it
-                # keeps fanning publishes into a dead subscription until
-                # another client's idle sweep evicts it)
-                self._post("/unsubscribe", {"topic": self.name,
-                                            "client": ent[3]})
-            except Exception:
-                pass  # server gone: its consumer map died with it
 
 
 class HttpBrokerClient(Broker):
