@@ -108,6 +108,61 @@ class AutoEncoder(Layer):
 
 
 # ---------------------------------------------------------------------------
+# VAE reconstruction distributions (reference
+# conf/layers/variational/{Gaussian,Bernoulli,Exponential,Composite}
+# ReconstructionDistribution.java). Each kind defines how many pre-out
+# units it consumes per data unit, its per-example negative log
+# probability, and its mean for generate().
+#   "gaussian"         fixed unit variance, D pre-out (this framework's
+#                      original formulation — kept for checkpoint
+#                      back-compat; documented divergence)
+#   "gaussian_learned" reference GaussianReconstructionDistribution:
+#                      [mean | log-var], 2*D pre-out, full NLL constants
+#   "bernoulli"        sigmoid logits, D pre-out
+#   "exponential"      gamma = log(lambda), D pre-out
+#                      (ExponentialReconstructionDistribution.java:59-74)
+# Composite = a list of (kind, size) slices over the feature axis
+# (CompositeReconstructionDistribution.java).
+# ---------------------------------------------------------------------------
+
+def _dist_pre_size(kind: str, d: int) -> int:
+    return 2 * d if kind == "gaussian_learned" else d
+
+
+def _dist_nll(kind: str, pre, x):
+    """Per-example negative log probability, summed over this slice's
+    features. `pre` [B, pre_size], `x` [B, d]."""
+    if kind == "bernoulli":
+        return jnp.sum(jnp.maximum(pre, 0) - pre * x
+                       + jnp.log1p(jnp.exp(-jnp.abs(pre))), axis=-1)
+    if kind == "gaussian":  # unit variance, no constants (legacy)
+        return 0.5 * jnp.sum((pre - x) ** 2, axis=-1)
+    if kind == "gaussian_learned":
+        d = x.shape[-1]
+        mean, log_var = pre[..., :d], pre[..., d:]
+        return 0.5 * jnp.sum(
+            jnp.log(2 * jnp.pi) + log_var
+            + (x - mean) ** 2 / jnp.exp(log_var), axis=-1)
+    if kind == "exponential":
+        # p(x) = lambda exp(-lambda x), lambda = exp(gamma):
+        # -log p = lambda * x - gamma
+        return jnp.sum(jnp.exp(pre) * x - pre, axis=-1)
+    raise ValueError(f"unknown reconstruction distribution {kind!r}")
+
+
+def _dist_mean(kind: str, pre, d: int):
+    """E[x | pre] for generate()/reconstruction."""
+    if kind == "bernoulli":
+        return jax.nn.sigmoid(pre)
+    if kind == "gaussian":
+        return pre
+    if kind == "gaussian_learned":
+        return pre[..., :d]
+    if kind == "exponential":
+        return jnp.exp(-pre)  # 1 / lambda
+    raise ValueError(f"unknown reconstruction distribution {kind!r}")
+
+
 @serde.register
 @dataclass
 class VariationalAutoencoder(Layer):
@@ -119,7 +174,10 @@ class VariationalAutoencoder(Layer):
     n_out: int = 0  # latent dimension
     encoder_layer_sizes: Sequence[int] = (64,)
     decoder_layer_sizes: Sequence[int] = (64,)
-    reconstruction_distribution: str = "gaussian"  # or "bernoulli"
+    # A kind string ("gaussian" | "gaussian_learned" | "bernoulli" |
+    # "exponential") or a COMPOSITE list of [kind, size] feature slices
+    # (reference CompositeReconstructionDistribution) summing to n_in.
+    reconstruction_distribution: object = "gaussian"
     pzx_activation: str = "identity"
     num_samples: int = 1
 
@@ -127,6 +185,29 @@ class VariationalAutoencoder(Layer):
         if isinstance(input_type, FeedForwardType) and self.n_in == 0:
             self.n_in = input_type.size
         return FeedForwardType(size=self.n_out)
+
+    def _dist_slices(self):
+        """[(kind, x_lo, x_hi, pre_lo, pre_hi)] covering the feature
+        axis; a single kind is one full-width slice."""
+        spec = self.reconstruction_distribution
+        if isinstance(spec, str):
+            spec = [(spec, self.n_in)]
+        out = []
+        x_lo = pre_lo = 0
+        for kind, d in (tuple(s) for s in spec):
+            d = int(d)
+            ps = _dist_pre_size(kind, d)
+            out.append((kind, x_lo, x_lo + d, pre_lo, pre_lo + ps))
+            x_lo += d
+            pre_lo += ps
+        if x_lo != self.n_in:
+            raise ValueError(
+                f"composite reconstruction slices cover {x_lo} features; "
+                f"layer has n_in={self.n_in}")
+        return out
+
+    def _pre_out_size(self) -> int:
+        return self._dist_slices()[-1][4]
 
     def has_params(self):
         return True
@@ -161,9 +242,12 @@ class VariationalAutoencoder(Layer):
             p[f"d{i}b"] = jnp.zeros((sizes_d[i + 1],), dtype)
             k += 1
         h_d = sizes_d[-1]
-        p["pW"] = self._winit(keys[k], (h_d, self.n_in), h_d, self.n_in,
-                              dtype)
-        p["pb"] = jnp.zeros((self.n_in,), dtype)
+        # pre-out width follows the reconstruction distribution(s):
+        # n_in for gaussian/bernoulli/exponential, 2*d for learned-
+        # variance gaussian slices (reference distributionInputSize)
+        pre = self._pre_out_size()
+        p["pW"] = self._winit(keys[k], (h_d, pre), h_d, pre, dtype)
+        p["pb"] = jnp.zeros((pre,), dtype)
         return p
 
     def param_reg(self, pname):
@@ -200,9 +284,17 @@ class VariationalAutoencoder(Layer):
     def generate(self, params, z):
         """Decode latent samples (reference generateAtMeanGivenZ)."""
         pre = self._decoder(params, z)
-        if self.reconstruction_distribution == "bernoulli":
-            return jax.nn.sigmoid(pre)
-        return pre
+        return jnp.concatenate(
+            [_dist_mean(kind, pre[..., p0:p1], x1 - x0)
+             for kind, x0, x1, p0, p1 in self._dist_slices()], axis=-1)
+
+    def _recon_nll(self, pre, x):
+        """Negative log p(x|z) summed over features, slice-wise over the
+        composite spec."""
+        total = 0.0
+        for kind, x0, x1, p0, p1 in self._dist_slices():
+            total = total + _dist_nll(kind, pre[..., p0:p1], x[..., x0:x1])
+        return total
 
     def pretrain_loss(self, params, x, rng):
         """Negative ELBO, MC-estimated with `num_samples` reparameterized
@@ -217,12 +309,7 @@ class VariationalAutoencoder(Layer):
                                     mean.dtype)
             z = mean + jnp.exp(0.5 * log_var) * eps
             pre = self._decoder(params, z)
-            if self.reconstruction_distribution == "bernoulli":
-                nll = jnp.sum(jnp.maximum(pre, 0) - pre * x
-                              + jnp.log1p(jnp.exp(-jnp.abs(pre))), axis=-1)
-            else:  # unit-variance gaussian
-                nll = 0.5 * jnp.sum((pre - x) ** 2, axis=-1)
-            recon_nll = recon_nll + nll
+            recon_nll = recon_nll + self._recon_nll(pre, x)
         recon_nll = recon_nll / self.num_samples
         return jnp.mean(recon_nll + kl)
 

@@ -45,7 +45,12 @@ class TestGradientChecks:
         finally:
             jax.config.update("jax_enable_x64", False)
 
-    @pytest.mark.parametrize("dist", ["gaussian", "bernoulli"])
+    @pytest.mark.parametrize("dist", [
+        "gaussian", "bernoulli", "gaussian_learned", "exponential",
+        # composite (reference CompositeReconstructionDistribution):
+        # 5 bernoulli bits + 4 learned-variance gaussians + 3 exponentials
+        (("bernoulli", 5), ("gaussian_learned", 4), ("exponential", 3)),
+    ])
     def test_vae_elbo_gradient(self, dist):
         jax.config.update("jax_enable_x64", True)
         try:
@@ -53,14 +58,56 @@ class TestGradientChecks:
                 n_out=4, encoder_layer_sizes=(9,), decoder_layer_sizes=(9,),
                 activation="tanh", reconstruction_distribution=dist)
             params = _init_layer(layer, 12)
-            x = jnp.asarray(_data(8, binary=(dist == "bernoulli")),
-                            jnp.float64)
+            positive = dist == "exponential" or isinstance(dist, tuple)
+            x = np.abs(_data(8)) if positive else \
+                _data(8, binary=(dist == "bernoulli"))
+            x = jnp.asarray(x, jnp.float64)
             rng = jax.random.PRNGKey(5)  # fixed draw: reparam is smooth
             assert gradient_check_fn(
                 lambda p: layer.pretrain_loss(p, x, rng), params,
                 epsilon=1e-6, max_rel_error=1e-4, max_params=120)
         finally:
             jax.config.update("jax_enable_x64", False)
+
+    def test_vae_distribution_pre_out_sizes(self):
+        """distributionInputSize parity: learned-variance gaussian takes
+        2 pre-out units per feature, the rest 1; composite sums; a
+        composite not covering n_in raises."""
+        mk = lambda spec: VariationalAutoencoder(
+            n_out=4, reconstruction_distribution=spec)
+        layer = mk("gaussian_learned")
+        layer.set_input_type(InputType.feed_forward(12))
+        assert layer._pre_out_size() == 24
+        layer = mk((("bernoulli", 5), ("gaussian_learned", 4),
+                    ("exponential", 3)))
+        layer.set_input_type(InputType.feed_forward(12))
+        assert layer._pre_out_size() == 5 + 8 + 3
+        params = _init_layer(mk((("bernoulli", 5),
+                                 ("gaussian_learned", 4),
+                                 ("exponential", 3))), 12,
+                             dtype=jnp.float32)
+        assert params["pW"].shape[1] == 16
+        bad = mk((("bernoulli", 5),))
+        bad.set_input_type(InputType.feed_forward(12))
+        with pytest.raises(ValueError, match="cover"):
+            bad._pre_out_size()
+
+    def test_vae_generate_means(self):
+        """generate() returns the distribution mean per slice: sigmoid
+        for bernoulli, mean half for learned gaussian, 1/lambda for
+        exponential — output width is n_in regardless of pre-out."""
+        layer = VariationalAutoencoder(
+            n_out=4, reconstruction_distribution=(
+                ("bernoulli", 5), ("gaussian_learned", 4),
+                ("exponential", 3)))
+        params = _init_layer(layer, 12, dtype=jnp.float32)
+        z = jnp.asarray(np.random.default_rng(0).standard_normal((6, 4)),
+                        jnp.float32)
+        out = layer.generate(params, z)
+        assert out.shape == (6, 12)
+        assert np.all(np.asarray(out[:, :5]) >= 0)   # sigmoid range
+        assert np.all(np.asarray(out[:, :5]) <= 1)
+        assert np.all(np.asarray(out[:, 9:]) > 0)    # 1/lambda > 0
 
     def test_center_loss_gradient(self):
         jax.config.update("jax_enable_x64", True)
