@@ -520,7 +520,12 @@ def bench_lenet_hostfed(batch=2048, n_train=8192, epochs=2):
         net.fit(it, epochs=epochs)
         float(net.score_value)
         dt = time.perf_counter() - t0
-        return served * epochs / dt
+        # Per-batch ETL breakdown from the device prefetcher (host-side
+        # pipeline wait vs host→device staging wait) — the split that
+        # tells tunnel-bound apart from pipeline-bound.
+        extra = {"etl_host_ms": round(net.last_etl_host_ms, 2),
+                 "etl_h2d_ms": round(net.last_etl_h2d_ms, 2)}
+        return served * epochs / dt, extra
     finally:
         shutil.rmtree(d, ignore_errors=True)
 
@@ -622,8 +627,8 @@ def run_once(workload: str, arg):
         ips = bench_etl()
         return "host_image_etl_images_per_sec", ips, "images/sec", {}
     if workload == "lenet_hostfed":
-        ips = bench_lenet_hostfed()
-        return "lenet_mnist_hostfed_images_per_sec", ips, "images/sec", {}
+        ips, ext = bench_lenet_hostfed()
+        return "lenet_mnist_hostfed_images_per_sec", ips, "images/sec", ext
     if workload == "attention_longctx":
         seq = int(arg) if arg else 8192
         tps, ext = bench_attention_longctx(seq_len=seq)
@@ -649,9 +654,14 @@ def main():
     arg = argv[1] if len(argv) > 1 else None
 
     if once:
-        metric, ips, unit, extra = run_once(workload, arg)
+        from deeplearning4j_tpu.optimize.telemetry import CompilationTracker
+        with CompilationTracker() as trk:
+            metric, ips, unit, extra = run_once(workload, arg)
+        # XLA compilations the measurement triggered: warm-up should own
+        # them all; steady-state recompiles (ragged shapes) show up here.
         print(json.dumps({"metric": metric, "value": round(ips, 1),
-                          "unit": unit, **extra}))
+                          "unit": unit, **extra,
+                          "xla_compilations": trk.count}))
         return
 
     # Process-level repeats in FRESH processes. With the shared compile
@@ -674,6 +684,7 @@ def main():
                          "/tmp/dl4jtpu_bench_jaxcache")
     sent_pre = host_sentinel_ms()
     runs = []
+    timed_out = False
     t_start = time.perf_counter()
     for i in range(repeats):
         elapsed = time.perf_counter() - t_start
@@ -696,14 +707,22 @@ def main():
                 timeout=child_limit,
                 cwd=os.path.dirname(os.path.abspath(__file__)) or ".")
         except subprocess.TimeoutExpired:
+            # A hung child must not sink the whole bench artifact: emit
+            # whatever completed as partial JSON with a loud timeout
+            # marker and exit 0 — the scoreboard records the config as
+            # timed out instead of the round losing its BENCH line.
+            timed_out = True
             if runs:  # keep what we have; report the smaller n
                 sys.stderr.write(
                     f"bench: child {i} exceeded {child_limit:.0f}s; "
                     f"reporting {len(runs)} repeats\n")
                 break
-            raise SystemExit(
-                f"bench subprocess exceeded {child_limit:.0f}s with no "
-                f"completed repeat")
+            sys.stderr.write(
+                f"bench: child 0 exceeded {child_limit:.0f}s with no "
+                f"completed repeat\n")
+            print(json.dumps({"workload": workload, "timeout": True,
+                              "spread": {"n": 0}}))
+            return
         lines = out.stdout.strip().splitlines()
         if out.returncode != 0 or not lines:
             sys.stderr.write(out.stderr[-2000:])
@@ -732,6 +751,8 @@ def main():
         "host_sentinel_ms": round(sent_med, 1),
         "host_sentinel_min_ms": round(sent_min, 1),
     }
+    if timed_out:
+        row["timeout"] = True
     if vs < 0.97:
         # loud: the median of N fresh processes is >3% below the best
         # recorded run — check host_sentinel_ms against BASELINE.md's

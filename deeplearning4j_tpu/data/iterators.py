@@ -170,12 +170,27 @@ class AsyncDataSetIterator(DataSetIterator):
         self._error: Optional[BaseException] = None
         self._shutdown = threading.Event()
 
+    def _produce_item(self, ds, host_ms: float):
+        """Hook for subclasses (DevicePrefetchIterator): transform a batch
+        on the producer thread before it enters the queue. `host_ms` is
+        the time the producer just spent pulling the batch from the base
+        iterator (host ETL)."""
+        return ds
+
     def _producer(self, q: queue.Queue):
+        import time
         try:
-            for ds in self._base:
+            it = iter(self._base)
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    ds = next(it)
+                except StopIteration:
+                    break
+                host_ms = (time.perf_counter() - t0) * 1000.0
                 if self._shutdown.is_set():
                     return
-                q.put(ds)
+                q.put(self._produce_item(ds, host_ms))
             q.put(self._SENTINEL)
         except BaseException as e:  # propagate to consumer
             self._error = e
@@ -347,3 +362,204 @@ class AsyncShieldDataSetIterator(DataSetIterator):
 
 class AsyncShieldMultiDataSetIterator(AsyncShieldDataSetIterator):
     """Multi-dataset flavor (reference AsyncShieldMultiDataSetIterator)."""
+
+
+class PadToBucketIterator(DataSetIterator):
+    """Pad ragged batches up to the epoch's canonical batch shape so ONE
+    compiled train step serves the whole epoch (the tf.data
+    pad-to-bucket idea applied to the XLA recompile problem: a short
+    final batch otherwise compiles a brand-new program per shape).
+
+    The canonical row count is the first batch's (the full-size batches
+    lead; only tails are ragged), so a dataset that fits in a single
+    batch is never padded and existing single-batch behavior is
+    untouched. Pad rows repeat the tail example and carry a zero-weight
+    labels mask (created when absent — data/padding.py contract), so
+    loss and gradients match the unpadded batch EXACTLY; score
+    normalization divides by real rows. BatchNorm train-mode statistics
+    and dropout draws still see pad rows (documented caveat).
+
+    Time-axis raggedness (variable sequence tails) pads only when the
+    batch already carries BOTH masks: zero-padding a rank>=2 mask leaves
+    sum(mask) — the loss denominator — unchanged, so the math stays
+    exact; synthesizing a time mask where none exists would flip the
+    normalization semantics, so maskless ragged-time batches pass
+    through unpadded (shape change, honest recompile)."""
+
+    def __init__(self, base, batch_size: Optional[int] = None):
+        self._base = base
+        self._fixed_target = batch_size
+        self._target: Optional[int] = batch_size
+        self._target_t: Optional[int] = None
+        self._it: Optional[Iterator] = None
+
+    def reset(self):
+        self._it = iter(self._base)
+        self._target = self._fixed_target
+        self._target_t = None
+
+    def __iter__(self):
+        self.reset()
+        return self
+
+    @staticmethod
+    def _pad_time(ds: DataSet, target_t: int) -> DataSet:
+        t = ds.features.shape[1]
+        pad = target_t - t
+        if pad <= 0:
+            return ds
+        def pad_axis1(a, val=0.0):
+            if a is None:
+                return None
+            a = np.asarray(a)
+            width = [(0, 0)] * a.ndim
+            width[1] = (0, pad)
+            return np.pad(a, width, constant_values=val)
+        return DataSet(pad_axis1(ds.features), pad_axis1(ds.labels),
+                       pad_axis1(ds.features_mask), pad_axis1(ds.labels_mask))
+
+    def __next__(self) -> DataSet:
+        from .padding import (pad_dataset_rows, pad_lmask_zero_weight,
+                              pad_multidataset_rows)
+        if self._it is None:
+            self.reset()
+        ds = next(self._it)
+        # Uniform mask structure across the epoch: padding only the tail
+        # batch would give it a labels mask the full batches lack, and
+        # jit retraces on pytree structure — two compiles, defeating the
+        # point. Every maskless batch gets the ones (n,1) mask, which
+        # the zero-weight contract guarantees is loss-exact (the rank-2
+        # mask path divides by sum(mask) = n).
+        if isinstance(ds, MultiDataSet):
+            if ds.labels_masks is None or any(m is None
+                                              for m in ds.labels_masks):
+                masks = ds.labels_masks or [None] * len(ds.labels)
+                ds = MultiDataSet(
+                    ds.features, ds.labels, ds.features_masks,
+                    [m if m is not None
+                     else pad_lmask_zero_weight(None, len(l), 0)
+                     for m, l in zip(masks, ds.labels)])
+            if self._target is None:
+                self._target = ds.num_examples()
+            return pad_multidataset_rows(ds, self._target)
+        if ds.labels_mask is None:
+            ds = DataSet(ds.features, ds.labels, ds.features_mask,
+                         pad_lmask_zero_weight(None, ds.num_examples(), 0))
+        # Ragged time tail: pad up to the canonical length when both
+        # masks are present (exactness requires them, see class doc).
+        if np.ndim(ds.features) == 3:
+            t = ds.features.shape[1]
+            if self._target_t is None:
+                self._target_t = t
+            elif t < self._target_t and ds.features_mask is not None \
+                    and ds.labels_mask is not None \
+                    and np.ndim(ds.labels_mask) >= 2:
+                ds = self._pad_time(ds, self._target_t)
+        if self._target is None:
+            self._target = ds.num_examples()
+        return pad_dataset_rows(ds, self._target)
+
+    def batch_size(self):
+        return self._base.batch_size() if hasattr(self._base, "batch_size") \
+            else self._fixed_target
+
+    def total_examples(self):
+        return self._base.total_examples() \
+            if hasattr(self._base, "total_examples") else None
+
+    def async_supported(self) -> bool:
+        base_ok = getattr(self._base, "async_supported", lambda: True)
+        return base_ok()
+
+
+class DevicePrefetchIterator(AsyncDataSetIterator):
+    """Background prefetch that stages batches ONTO THE DEVICE: the
+    producer thread runs `jax.device_put` (with an optional
+    NamedSharding for ParallelWrapper's mesh path) and blocks until the
+    transfer lands, so the training thread dequeues device-resident
+    arrays and never pays host→device latency inside the step loop —
+    the prefetch_to_device stage of tf.data (Murray et al., VLDB 2021)
+    for this framework. Shutdown/reset/error semantics are inherited
+    from AsyncDataSetIterator (same bounded queue + sentinel protocol).
+
+    `depth` bounds how many staged batches may be device-resident at
+    once (HBM cost: depth x batch bytes). `sharding` places every
+    staged array under that sharding; batches whose leading dimension
+    is not divisible by `batch_divisor` (the mesh's data-axis size)
+    skip device staging and pass through as host arrays, letting the
+    wrapper's zero-weight pad path handle them. `cast_dtype` pre-casts
+    floating FEATURE arrays to the network dtype on the producer thread
+    (the step-time `_cast_features` then no-ops).
+
+    Each staged batch carries its ETL breakdown as `_etl_host_ms` (time
+    the producer spent pulling it from the base iterator) and
+    `_etl_h2d_ms` (device_put + transfer wait); fit() surfaces them as
+    model.last_etl_host_ms / last_etl_h2d_ms next to the consumer-side
+    last_etl_ms stall clock."""
+
+    def __init__(self, base, depth: int = 2, sharding=None,
+                 batch_divisor: int = 1, cast_dtype=None):
+        super().__init__(base, queue_size=depth)
+        self._sharding = sharding
+        self._divisor = max(1, int(batch_divisor))
+        self._cast_dtype = cast_dtype
+
+    def _put(self, a, is_feature: bool):
+        import jax
+        import jax.numpy as jnp
+        if a is None:
+            return None
+        if is_feature and self._cast_dtype is not None:
+            dt = np.asarray(a).dtype if not isinstance(a, jax.Array) \
+                else a.dtype
+            if jnp.issubdtype(dt, jnp.floating):
+                a = jnp.asarray(a).astype(self._cast_dtype)
+        if self._sharding is not None:
+            return jax.device_put(a, self._sharding)
+        return jax.device_put(a)
+
+    def _stage(self, ds):
+        import jax
+        if isinstance(ds, MultiDataSet):
+            out = MultiDataSet(
+                [self._put(f, True) for f in ds.features],
+                [self._put(l, False) for l in ds.labels],
+                None if ds.features_masks is None
+                else [self._put(m, False) for m in ds.features_masks],
+                None if ds.labels_masks is None
+                else [self._put(m, False) for m in ds.labels_masks])
+            leaves = out.features + out.labels
+        elif isinstance(ds, DataSet):
+            out = DataSet(self._put(ds.features, True),
+                          self._put(ds.labels, False),
+                          self._put(ds.features_mask, False),
+                          self._put(ds.labels_mask, False))
+            leaves = [out.features, out.labels]
+        else:
+            return ds
+        # Fence on the producer thread: the consumer must never inherit
+        # an in-flight transfer (that wait would be invisible ETL).
+        jax.block_until_ready([a for a in leaves if a is not None])
+        return out
+
+    def _produce_item(self, ds, host_ms: float):
+        import time
+        n = getattr(ds, "num_examples", lambda: 0)()
+        if self._sharding is not None and n % self._divisor != 0:
+            # Indivisible ragged batch: staging under the sharding would
+            # fail (and a host round-trip to pad would cost MORE than
+            # letting the wrapper pad host-side). Pass through.
+            staged, h2d_ms = ds, 0.0
+        else:
+            t0 = time.perf_counter()
+            staged = self._stage(ds)
+            h2d_ms = (time.perf_counter() - t0) * 1000.0
+        try:
+            staged._etl_host_ms = host_ms
+            staged._etl_h2d_ms = h2d_ms
+        except AttributeError:
+            pass  # foreign batch type without attribute support
+        return staged
+
+    def async_supported(self) -> bool:
+        return False  # already threaded; fit() must not double-wrap

@@ -1,0 +1,94 @@
+"""Batch padding with bit-honest loss normalization.
+
+The zero-weight pad contract used everywhere a batch must be grown to a
+canonical shape (pad-to-bucket in the fit pipeline, divisibility padding
+in the DP/SP wrappers): appended rows repeat the tail example so the
+forward pass stays numerically tame, and a labels mask (created when
+absent) zero-weights them so the LOSS — numerator and normalization —
+exactly matches training on the original batch. Keeping the primitives
+in ONE module means the pad rule cannot drift between the data pipeline
+and the parallel wrappers (parallel/wrapper.py re-exports them).
+
+Caveat, inherited by every caller: pad rows still traverse the forward
+pass, so batch-statistics state (BatchNormalization train-mode mean/var)
+and shape-dependent dropout draws include them. Loss/gradients match
+exactly; BN/dropout models should use divisible batch sizes for
+bit-exact equivalence.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .dataset import DataSet, MultiDataSet
+
+
+def repeat_tail_rows(a, pad: int):
+    """Append `pad` copies of the last row (None-safe). Device-resident
+    (jax) arrays pad with jnp ops so they never round-trip through host
+    memory; host arrays stay numpy."""
+    if a is None or pad == 0:
+        return a
+    import jax
+    if isinstance(a, jax.Array):
+        import jax.numpy as jnp
+        return jnp.concatenate(
+            [a, jnp.broadcast_to(a[-1:], (pad,) + a.shape[1:])], 0)
+    a = np.asarray(a)
+    return np.concatenate(
+        [a, np.broadcast_to(a[-1:], (pad,) + a.shape[1:])], 0)
+
+
+def pad_lmask_zero_weight(lmask, n: int, pad: int):
+    """A labels mask covering `pad` appended rows, constructed so the
+    LOSS (numerator and normalization) exactly matches training on the
+    original `n`-row batch:
+      * no user mask  -> ones (n,1) + zero pad rows; the rank-2 mask
+        path divides by sum(mask) = n, preserving the unmasked
+        time-sum/batch-mean semantics (an (n,T) ones mask would NOT —
+        it flips the denominator to n*T).
+      * rank-1 user mask (per-example weights) -> zero-padded and
+        scaled by padded_n/n; the rank-1 mean path then yields
+        sum(sa*m)/n, the unpadded value (exact by linearity).
+      * rank>=2 user mask -> zero pad rows; sum(mask) is unchanged."""
+    if lmask is None:
+        m = np.ones((n, 1), np.float32)
+    else:
+        m = np.asarray(lmask, np.float32)
+    zeros = np.zeros((pad,) + m.shape[1:], m.dtype)
+    out = np.concatenate([m, zeros], axis=0)
+    if out.ndim == 1:
+        # Rank-1 masks take the mean-over-batch loss path; rescale so
+        # mean over padded_n equals the unpadded mean over n.
+        out = out * (out.shape[0] / float(n))
+    return out
+
+
+def pad_dataset_rows(ds: DataSet, target: int) -> DataSet:
+    """Pad a DataSet's batch dimension up to `target` rows under the
+    zero-weight contract. A no-op when already at (or beyond) target."""
+    n = ds.num_examples()
+    pad = target - n
+    if pad <= 0:
+        return ds
+    return DataSet(repeat_tail_rows(ds.features, pad),
+                   repeat_tail_rows(ds.labels, pad),
+                   repeat_tail_rows(ds.features_mask, pad),
+                   pad_lmask_zero_weight(ds.labels_mask, n, pad))
+
+
+def pad_multidataset_rows(mds: MultiDataSet, target: int) -> MultiDataSet:
+    """pad_dataset_rows for MultiDataSet: every output head gets a
+    zero-weight mask over the pad rows (masks list created when
+    absent)."""
+    n = mds.num_examples()
+    pad = target - n
+    if pad <= 0:
+        return mds
+    lmasks = mds.labels_masks if mds.labels_masks is not None \
+        else [None] * len(mds.labels)
+    return MultiDataSet(
+        [repeat_tail_rows(f, pad) for f in mds.features],
+        [repeat_tail_rows(l, pad) for l in mds.labels],
+        None if mds.features_masks is None
+        else [repeat_tail_rows(m, pad) for m in mds.features_masks],
+        [pad_lmask_zero_weight(m, n, pad) for m in lmasks])

@@ -65,6 +65,12 @@ class ComputationGraph(DeviceIterationMixin):
         self.epoch = 0
         self.listeners: List[Any] = []
         self.score_value = None
+        # Data-pipeline wait for the most recent batch (reference
+        # lastEtlTime), split host-wait vs h2d-wait when the device
+        # prefetcher is active.
+        self.last_etl_ms: float = 0.0
+        self.last_etl_host_ms: float = 0.0
+        self.last_etl_h2d_ms: float = 0.0
         self._dtype = jnp.float32
         self._rng = None
         self._initialized = False
@@ -312,16 +318,26 @@ class ComputationGraph(DeviceIterationMixin):
     # ------------------------------------------------------------------- fit
     def fit(self, data, labels=None, *, epochs: int = 1,
             batch_size: int = 32, step_fn=None, use_async: bool = True,
-            async_queue_size: int = 8, steps_per_dispatch: int = 1
+            async_queue_size: int = 8, steps_per_dispatch: int = 1,
+            pad_to_bucket: bool = True, prefetch_to_device: bool = True,
+            prefetch_depth: int = 2, prefetch_sharding=None,
+            prefetch_divisor: int = 1
             ) -> "ComputationGraph":
         """Train (reference fit(MultiDataSetIterator):867). Accepts a
         MultiDataSet, DataSet, (features, labels) arrays, or an iterator of
         either. `step_fn` lets ParallelWrapper substitute a sharded step.
         Batches prefetch on a background thread (the reference wraps with
-        AsyncMultiDataSetIterator at :867) unless use_async=False.
+        AsyncMultiDataSetIterator at :867) unless use_async=False;
+        `prefetch_to_device` upgrades that thread to stage batches onto
+        the device, and `pad_to_bucket` pads ragged batches to the
+        epoch's canonical shape under the zero-weight mask contract so
+        one compiled step serves the whole epoch
+        (docs/perf_data_pipeline.md — both mirror MultiLayerNetwork.fit).
         `steps_per_dispatch > 1` groups same-shaped batches into one
         fused lax.scan dispatch (see MultiLayerNetwork.fit)."""
-        from ...data.iterators import AsyncMultiDataSetIterator
+        from ...data.iterators import (AsyncMultiDataSetIterator,
+                                       DevicePrefetchIterator,
+                                       PadToBucketIterator)
         self._check_init()
         spd = int(steps_per_dispatch)
         if spd > 1 and step_fn is not None:
@@ -342,9 +358,21 @@ class ComputationGraph(DeviceIterationMixin):
         else:
             mds = self._coerce(data, labels)
             iterator = _SlicingMultiIterator(mds, batch_size)
+        if pad_to_bucket and \
+                self.conf.backprop_type != BackpropType.TRUNCATED_BPTT:
+            # Same tBPTT gate as MultiLayerNetwork.fit: the synthesized
+            # (n,1) zero-weight mask cannot be time-windowed.
+            iterator = PadToBucketIterator(iterator)
         async_ok = getattr(iterator, "async_supported", lambda: True)()
-        wrapped = AsyncMultiDataSetIterator(iterator, async_queue_size) \
-            if (use_async and async_ok) else iterator
+        if use_async and async_ok:
+            wrapped = DevicePrefetchIterator(
+                iterator, depth=max(1, int(prefetch_depth)),
+                sharding=prefetch_sharding,
+                batch_divisor=prefetch_divisor,
+                cast_dtype=self._dtype) if prefetch_to_device \
+                else AsyncMultiDataSetIterator(iterator, async_queue_size)
+        else:
+            wrapped = iterator
         group: List[MultiDataSet] = []
 
         def group_sig(m):
@@ -365,9 +393,24 @@ class ComputationGraph(DeviceIterationMixin):
                 self.fit_batches(group)
             group.clear()
 
+        import time as _time
         try:
             for _ in range(epochs):
-                for ds in wrapped:
+                it_epoch = iter(wrapped)
+                while True:
+                    # Track time blocked on the data pipeline (reference
+                    # lastEtlTime); PerformanceListener reports it, with
+                    # the producer-side host/h2d split when device
+                    # prefetch is active.
+                    t0 = _time.perf_counter()
+                    try:
+                        ds = next(it_epoch)
+                    except StopIteration:
+                        break
+                    self.last_etl_ms = (_time.perf_counter() - t0) * 1000.0
+                    self.last_etl_host_ms = getattr(
+                        ds, "_etl_host_ms", self.last_etl_ms)
+                    self.last_etl_h2d_ms = getattr(ds, "_etl_h2d_ms", 0.0)
                     mds = self._coerce(ds)
                     if spd <= 1:
                         step(mds)
@@ -397,8 +440,10 @@ class ComputationGraph(DeviceIterationMixin):
         if self.conf.backprop_type == BackpropType.TRUNCATED_BPTT:
             # ANY rank-3 input triggers windowing (static rank-2 inputs
             # pass whole into every window — _fit_tbptt handles the mix).
-            any_seq = any(np.asarray(f).ndim == 3 for f in mds.features)
-            labels_rank3 = all(np.asarray(l).ndim == 3 for l in mds.labels)
+            # np.ndim reads .ndim without materializing — np.asarray on a
+            # device-resident array would force a d2h copy per batch.
+            any_seq = any(np.ndim(f) == 3 for f in mds.features)
+            labels_rank3 = all(np.ndim(l) == 3 for l in mds.labels)
             if any_seq and labels_rank3:
                 self._fit_tbptt(mds, do_step)
                 return

@@ -93,8 +93,10 @@ class SelfAttentionLayer(Layer):
         if self.block_size == -1:
             return 0
         if self.block_size > 0:
-            return self.block_size if t % self.block_size == 0 \
-                and t > self.block_size else 0
+            # "whenever it divides t" (field doc) — including t ==
+            # block_size, where blockwise runs as a single block
+            # (ops/attention.py handles nq == nk == 1).
+            return self.block_size if t % self.block_size == 0 else 0
         if t < 2048:
             return 0
         # 512 first: measured fastest on v5e (bf16, d<=128 heads) —

@@ -76,6 +76,12 @@ class MultiLayerNetwork(DeviceIterationMixin):
         self.epoch = 0
         self.listeners: List[Any] = []
         self.score_value: Optional[float] = None
+        # Data-pipeline wait for the most recent batch (reference
+        # lastEtlTime), split producer-side into host-wait vs h2d-wait
+        # when the device prefetcher is active.
+        self.last_etl_ms: float = 0.0
+        self.last_etl_host_ms: float = 0.0
+        self.last_etl_h2d_ms: float = 0.0
         self._dtype = jnp.float32
         self._rng: Optional[Array] = None
         self._train_step_fn = None
@@ -300,11 +306,25 @@ class MultiLayerNetwork(DeviceIterationMixin):
     # ------------------------------------------------------------------- fit
     def fit(self, data, labels=None, *, epochs: int = 1, batch_size: int = 32,
             use_async: bool = True, async_queue_size: int = 8,
-            step_fn=None, steps_per_dispatch: int = 1
+            step_fn=None, steps_per_dispatch: int = 1,
+            pad_to_bucket: bool = True, prefetch_to_device: bool = True,
+            prefetch_depth: int = 2, prefetch_sharding=None,
+            prefetch_divisor: int = 1
             ) -> "MultiLayerNetwork":
         """Train (reference fit(DataSetIterator):1019). Accepts a
         DataSetIterator, a DataSet, or (features, labels) arrays. `step_fn`
         lets ParallelWrapper reuse this loop with a sharded step.
+
+        Input pipeline (docs/perf_data_pipeline.md): `pad_to_bucket`
+        pads ragged batches (the short final batch) up to the epoch's
+        canonical shape under the zero-weight mask contract — loss and
+        gradients match the unpadded batch exactly, and the whole epoch
+        reuses ONE compiled train step. `prefetch_to_device` upgrades
+        the async prefetch thread to stage batches onto the device
+        (`jax.device_put` + transfer fence off the training thread);
+        `prefetch_sharding`/`prefetch_divisor` let ParallelWrapper stage
+        mesh-sharded batches. Both honor use_async=False (no threads)
+        and AsyncShield iterators.
 
         `steps_per_dispatch > 1` groups that many same-shaped minibatches
         into ONE fused device dispatch (fit_batches' lax.scan —
@@ -316,14 +336,28 @@ class MultiLayerNetwork(DeviceIterationMixin):
         the window count), not one per window — the same coalescing
         fit_batch_repeated does; per-window listener events require
         steps_per_dispatch=1."""
+        from ..data.iterators import DevicePrefetchIterator, PadToBucketIterator
         self._check_init()
         spd = int(steps_per_dispatch)
         if spd > 1 and step_fn is not None:
             raise ValueError("steps_per_dispatch cannot combine with a "
                              "custom step_fn")
         it = as_iterator(data, labels, batch_size)
-        wrapped = AsyncDataSetIterator(it, async_queue_size) \
-            if (use_async and it.async_supported()) else it
+        if pad_to_bucket and \
+                self.conf.backprop_type != BackpropType.TRUNCATED_BPTT:
+            # tBPTT slices the labels mask on the time axis; the (n,1)
+            # zero-weight mask cannot window — ragged tBPTT batches keep
+            # the flush-and-recompile path (loudly documented).
+            it = PadToBucketIterator(it)
+        if use_async and it.async_supported():
+            wrapped = DevicePrefetchIterator(
+                it, depth=max(1, int(prefetch_depth)),
+                sharding=prefetch_sharding,
+                batch_divisor=prefetch_divisor,
+                cast_dtype=self._dtype) if prefetch_to_device \
+                else AsyncDataSetIterator(it, async_queue_size)
+        else:
+            wrapped = it
         step = step_fn or self._fit_batch
         group: List[DataSet] = []
 
@@ -358,6 +392,13 @@ class MultiLayerNetwork(DeviceIterationMixin):
                     except StopIteration:
                         break
                     self.last_etl_ms = (_time.perf_counter() - t0) * 1000.0
+                    # Device-prefetched batches carry the producer-side
+                    # split: host-wait (base iterator) vs h2d-wait
+                    # (device_put + transfer fence). Host-fed batches
+                    # attribute the whole wait to the host side.
+                    self.last_etl_host_ms = getattr(
+                        ds, "_etl_host_ms", self.last_etl_ms)
+                    self.last_etl_h2d_ms = getattr(ds, "_etl_h2d_ms", 0.0)
                     if spd <= 1:
                         step(ds)
                         continue

@@ -302,13 +302,13 @@ def ring_self_attention(q, k, v, mesh, *, axis: str = "seq",
             f"block_size={block_size}")
     body = _ring_body(axis, n_dev, t // n_dev, causal, block_size)
     spec_qkv = P(batch_axis, axis, head_axis, None)
+    from ..parallel.mesh import shard_map_compat
     if key_mask is None:
-        fn = jax.shard_map(lambda a, b, c: body(a, b, c, None), mesh=mesh,
-                           in_specs=(spec_qkv,) * 3, out_specs=spec_qkv,
-                           check_vma=False)
+        fn = shard_map_compat(lambda a, b, c: body(a, b, c, None), mesh,
+                              in_specs=(spec_qkv,) * 3, out_specs=spec_qkv)
         return fn(q, k, v)
-    fn = jax.shard_map(body, mesh=mesh,
-                       in_specs=(spec_qkv, spec_qkv, spec_qkv,
-                                 P(batch_axis, axis)),
-                       out_specs=spec_qkv, check_vma=False)
+    fn = shard_map_compat(body, mesh,
+                          in_specs=(spec_qkv, spec_qkv, spec_qkv,
+                                    P(batch_axis, axis)),
+                          out_specs=spec_qkv)
     return fn(q, k, v, key_mask)

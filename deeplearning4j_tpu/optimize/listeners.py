@@ -46,7 +46,13 @@ class ScoreIterationListener(IterationListener):
 class PerformanceListener(IterationListener):
     """Throughput reporting (reference PerformanceListener: samples/sec,
     batches/sec, iteration wall time). NB: fetches the score each report,
-    which fences the async dispatch queue — frequency matters on TPU."""
+    which fences the async dispatch queue — frequency matters on TPU.
+
+    Beyond the reference: the ETL stall splits into host-wait vs
+    h2d-wait when the device prefetcher is active, and each report
+    carries the XLA compilations observed since the previous one — a
+    nonzero count at steady state is the recompile-per-shape bug
+    pad-to-bucket exists to kill (docs/perf_data_pipeline.md)."""
 
     def __init__(self, frequency: int = 10, report_samples: bool = True,
                  printer=None):
@@ -56,6 +62,8 @@ class PerformanceListener(IterationListener):
         self._last_time: Optional[float] = None
         self._last_iter: Optional[int] = None
         self._last_batch_size: Optional[int] = None
+        self._last_compiles: Optional[int] = None
+        self.last_compile_delta: int = 0
 
     def set_batch_size(self, n: int):
         self._last_batch_size = int(n)
@@ -64,6 +72,8 @@ class PerformanceListener(IterationListener):
         if iteration % self.frequency != 0:
             return
         float(model.score_value)  # fence: measure real device time
+        from .telemetry import compilation_count
+        compiles = compilation_count()
         now = time.perf_counter()
         if self._last_time is not None and iteration > self._last_iter:
             dt = now - self._last_time
@@ -75,9 +85,18 @@ class PerformanceListener(IterationListener):
             etl = getattr(model, "last_etl_ms", None)
             if etl is not None:
                 msg += f", etl {etl:.2f} ms"
+                host = getattr(model, "last_etl_host_ms", None)
+                h2d = getattr(model, "last_etl_h2d_ms", None)
+                if host is not None and h2d is not None:
+                    msg += f" (host {host:.2f} ms, h2d {h2d:.2f} ms)"
+            self.last_compile_delta = compiles - self._last_compiles \
+                if self._last_compiles is not None else 0
+            if self.last_compile_delta:
+                msg += f", {self.last_compile_delta} xla compilations"
             self._printer(msg)
         self._last_time = now
         self._last_iter = iteration
+        self._last_compiles = compiles
 
 
 class ParamAndGradientIterationListener(IterationListener):

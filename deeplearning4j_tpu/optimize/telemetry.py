@@ -1,0 +1,105 @@
+"""XLA compilation telemetry.
+
+"One compile per epoch" is an invariant worth enforcing, not inferring:
+a ragged final batch silently compiling a second train-step program
+costs seconds of wall time per epoch and shows up nowhere. This module
+counts backend compilations two ways:
+
+* A process-global counter fed by a `jax.monitoring` duration listener
+  on the backend-compile event — every XLA compilation in the process,
+  whatever jitted function triggered it. `CompilationTracker` snapshots
+  it around a region (bench.py wraps whole workloads;
+  PerformanceListener reports the delta between reports).
+* `jit_cache_size(fn)` — the per-function executable-cache size of one
+  `jax.jit` callable (e.g. `net._train_step_fn`), the precise "how many
+  distinct shapes did THIS step compile for" probe the regression tests
+  pin.
+
+The monitoring listener registers lazily on first use and never
+unregisters (jax.monitoring only offers clear-all); it is a counter
+bump per compilation — harmless at steady state, where the whole point
+is that compilations stop happening.
+"""
+from __future__ import annotations
+
+import threading
+
+_lock = threading.Lock()
+_compile_count = 0
+_listening = False
+
+# The event jax records around every backend (XLA) compilation; stable
+# across recent jax versions. Matching on the suffix keeps us robust to
+# the '/jax/core' vs '/jax' prefix shuffle between releases.
+_COMPILE_EVENT_SUFFIX = "backend_compile_duration"
+
+
+def _on_event(event: str, duration: float, **_kw) -> None:
+    global _compile_count
+    if event.endswith(_COMPILE_EVENT_SUFFIX):
+        with _lock:
+            _compile_count += 1
+
+
+def _ensure_listener() -> bool:
+    global _listening
+    if _listening:
+        return True
+    with _lock:
+        if _listening:
+            return True
+        try:
+            from jax import monitoring
+            monitoring.register_event_duration_secs_listener(_on_event)
+        except Exception:
+            return False  # jax without monitoring: counters stay at 0
+        _listening = True
+    return True
+
+
+def compilation_count() -> int:
+    """Process-global backend compilations observed since the listener
+    registered (monotonic; meaningful as deltas)."""
+    _ensure_listener()
+    with _lock:
+        return _compile_count
+
+
+class CompilationTracker:
+    """Snapshot-delta view of the global compile counter.
+
+        with CompilationTracker() as trk:
+            net.fit(it, epochs=1)
+        assert trk.count == 1
+
+    Usable as a context manager or via explicit `.start()`."""
+
+    def __init__(self):
+        self.start_count = compilation_count()
+
+    def start(self) -> "CompilationTracker":
+        self.start_count = compilation_count()
+        return self
+
+    @property
+    def count(self) -> int:
+        return compilation_count() - self.start_count
+
+    def __enter__(self) -> "CompilationTracker":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+
+def jit_cache_size(fn) -> int:
+    """Number of compiled executables cached by one jax.jit callable —
+    the per-shape compile count of THAT function. Returns -1 when the
+    jax version exposes no cache probe."""
+    probe = getattr(fn, "_cache_size", None)
+    if probe is None:
+        return -1
+    try:
+        return int(probe())
+    except Exception:
+        return -1

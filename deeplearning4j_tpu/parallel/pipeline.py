@@ -102,6 +102,17 @@ class PipelineParallelWrapper:
                     f"body layer {i} differs from layer 0 — the pipeline "
                     f"body must be IDENTICAL layers (got a heterogeneous "
                     f"stack; use TP/DP/SP for those)")
+        # Stateful layers first: they may lack n_in/n_out entirely
+        # (BatchNormalization), so this must precede the chaining check.
+        import jax.numpy as jnp
+        for i, l in enumerate(layers):
+            if l.init_state(jnp.float32):
+                raise ValueError(
+                    f"layer {i} is stateful (non-empty init_state, e.g. "
+                    f"batch-norm running statistics); stage_apply drops "
+                    f"returned state, so its updates would be silently "
+                    f"lost — stateful layers are unsupported under "
+                    f"pipeline parallelism")
         l0 = body[0]
         if l0.n_in != l0.n_out:
             raise ValueError(
@@ -203,10 +214,11 @@ class PipelineParallelWrapper:
             # the full-batch mean)
             return jax.lax.psum(loss_acc, axis) / M
 
-        smapped = jax.shard_map(
-            spmd_loss, mesh=self.mesh,
+        from .mesh import shard_map_compat
+        smapped = shard_map_compat(
+            spmd_loss, self.mesh,
             in_specs=(P(axis), P(), P(), P()),
-            out_specs=P(), check_vma=False)
+            out_specs=P())
 
         def loss_fn(body_p, out_p, x_mb, y_mb):
             loss = smapped(body_p, out_p, x_mb, y_mb)
@@ -321,8 +333,13 @@ class PipelineParallelWrapper:
                     or data.labels_mask is not None):
                 raise NotImplementedError(
                     "masks are unsupported under pipeline parallelism")
+        # pad_to_bucket OFF: it synthesizes the labels mask this wrapper
+        # rejects, and zero-weight pad rows would train for real in the
+        # bubble schedule. Device prefetch OFF: batches are re-placed
+        # per-stage inside fit_batch.
         self.model.fit(data, labels, epochs=epochs, batch_size=batch_size,
-                       step_fn=self.fit_batch)
+                       step_fn=self.fit_batch, pad_to_bucket=False,
+                       prefetch_to_device=False)
         return self
 
     # -------------------------------------------------------------- evidence

@@ -139,8 +139,13 @@ class SequenceParallelWrapper:
     def fit(self, data, labels=None, *, epochs: int = 1,
             batch_size: int = 128) -> "SequenceParallelWrapper":
         self.model._check_init()
+        # pad_to_bucket OFF: this wrapper owns its tail padding (to the
+        # data-axis multiple, not the bucket shape) and places batches
+        # under the seq mesh itself, so generic device prefetch is also
+        # skipped.
         self.model.fit(data, labels, epochs=epochs, batch_size=batch_size,
-                       step_fn=self.fit_batch)
+                       step_fn=self.fit_batch, pad_to_bucket=False,
+                       prefetch_to_device=False)
         return self
 
     def fit_batch(self, ds) -> None:

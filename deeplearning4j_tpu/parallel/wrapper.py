@@ -35,49 +35,13 @@ import numpy as np
 from jax.sharding import Mesh
 
 from . import mesh as mesh_lib
+# The pad primitives live with the data pipeline (data/padding.py) so the
+# pad-to-bucket iterator and the DP/SP wrappers share ONE contract; the
+# historical names stay importable from here (sequence.py does).
+from ..data.padding import pad_lmask_zero_weight, repeat_tail_rows  # noqa: F401
 from ..nn.layers.recurrent import RECURRENT_CARRY_KEYS
 
 log = logging.getLogger(__name__)
-
-
-def repeat_tail_rows(a, pad: int):
-    """Append `pad` copies of the last row (None-safe) — the batch-pad
-    primitive shared by the DP/SP wrappers and their recurrent-carry
-    padding, extracted (like pad_lmask_zero_weight) so the pad rule
-    cannot drift between call sites."""
-    if a is None or pad == 0:
-        return a
-    import jax.numpy as jnp
-    a = jnp.asarray(a)
-    return jnp.concatenate(
-        [a, jnp.broadcast_to(a[-1:], (pad,) + a.shape[1:])], 0)
-
-
-def pad_lmask_zero_weight(lmask, n: int, pad: int):
-    """The zero-weight pad-mask contract, shared by ParallelWrapper and
-    SequenceParallelWrapper so it cannot drift: a labels mask covering
-    `pad` appended rows, constructed so the LOSS (numerator and
-    normalization) exactly matches single-device training on the
-    original `n`-row batch:
-      * no user mask  -> ones (n,1) + zero pad rows; the rank-2 mask
-        path divides by sum(mask) = n, preserving the unmasked
-        time-sum/batch-mean semantics (an (n,T) ones mask would NOT —
-        it flips the denominator to n*T).
-      * rank-1 user mask (per-example weights) -> zero-padded and
-        scaled by padded_n/n; the rank-1 mean path then yields
-        sum(sa*m)/n, the unpadded value (exact by linearity).
-      * rank>=2 user mask -> zero pad rows; sum(mask) is unchanged."""
-    if lmask is None:
-        m = np.ones((n, 1), np.float32)
-    else:
-        m = np.asarray(lmask, np.float32)
-    zeros = np.zeros((pad,) + m.shape[1:], m.dtype)
-    out = np.concatenate([m, zeros], axis=0)
-    if out.ndim == 1:
-        # Rank-1 masks take the mean-over-batch loss path; rescale so
-        # mean over padded_n equals the unpadded mean over n.
-        out = out * (out.shape[0] / float(n))
-    return out
 
 
 class ParallelWrapper:
@@ -208,13 +172,26 @@ class ParallelWrapper:
         """Reuses the single-device epoch/listener loop with the sharded
         step substituted, so loop semantics can never diverge."""
         self.model._check_init()
+        # Device prefetch stages batches already sharded over the mesh
+        # (device_put with the batch NamedSharding on the producer
+        # thread); _shard_arr then sees a correctly-sharded jax.Array
+        # and passes it through without a host round-trip. Indivisible
+        # ragged batches bypass staging (batch_divisor) and take the
+        # host-side zero-weight pad path as before. Multi-host meshes
+        # keep host feeding: per-process placement happens inside
+        # _shard_arr and cannot run on a producer thread safely.
+        prefetch = dict(prefetch_to_device=not self.multiprocess,
+                        prefetch_sharding=None if self.multiprocess
+                        else mesh_lib.batch_sharded(self.mesh),
+                        prefetch_divisor=self.data_shards)
         if hasattr(self.model, "_pack"):  # ComputationGraph
             self.model.fit(data, labels, epochs=epochs,
-                           batch_size=batch_size, step_fn=self.fit_batch)
+                           batch_size=batch_size, step_fn=self.fit_batch,
+                           **prefetch)
         else:
             self.model.fit(data, labels, epochs=epochs, batch_size=batch_size,
                            async_queue_size=self.prefetch_buffer,
-                           step_fn=self.fit_batch)
+                           step_fn=self.fit_batch, **prefetch)
         self.finalize()
         return self
 

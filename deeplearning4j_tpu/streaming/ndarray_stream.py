@@ -152,9 +152,15 @@ class _HttpTopic:
         # Register the server-side subscription SYNCHRONOUSLY (a
         # zero-wait consume) so subscribe-then-publish cannot lose the
         # first message to the poller's startup window — the
-        # InProcessBroker ordering guarantee holds over HTTP too.
-        self._post("/consume", {"topic": self.name, "client": client,
-                                "timeout": 0.0})
+        # InProcessBroker ordering guarantee holds over HTTP too. The
+        # registration consume can itself return a message (a publish
+        # raced between a previous subscriber's registration and now, or
+        # the server pre-seeded the queue) — dropping that payload would
+        # silently lose the first message, so deliver it here.
+        out = self._post("/consume", {"topic": self.name, "client": client,
+                                      "timeout": 0.0})
+        if not out.get("empty", True):
+            q.put_nowait(_decode(out))
 
         warned = [False]
 

@@ -86,6 +86,9 @@ class TestBlockwiseAttention:
         assert layer._pick_block(2050) == 0  # no dividing block
         assert SelfAttentionLayer(n_out=16, n_heads=4,
                                   block_size=256)._pick_block(1024) == 256
+        # "whenever it divides t" includes t == block_size (one block)
+        assert SelfAttentionLayer(n_out=16, n_heads=4,
+                                  block_size=256)._pick_block(256) == 256
         assert SelfAttentionLayer(n_out=16, n_heads=4,
                                   block_size=-1)._pick_block(8192) == 0
 

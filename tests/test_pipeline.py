@@ -161,6 +161,22 @@ class TestPipelineValidation:
         with pytest.raises(ValueError, match="divide"):
             PipelineParallelWrapper(net, pipeline_mesh(4))
 
+    def test_stateful_layer_rejected(self):
+        """stage_apply drops returned state, so a layer with non-empty
+        init_state (batch-norm running stats) would silently lose its
+        updates — rejected loudly instead."""
+        from deeplearning4j_tpu import BatchNormalization
+        conf = (NeuralNetConfiguration.builder().seed(1).updater(Sgd(0.1))
+                .list()
+                .layer(BatchNormalization(n_out=16))
+                .layer(BatchNormalization(n_out=16))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(16)).build())
+        net = MultiLayerNetwork(conf).init()
+        with pytest.raises(ValueError, match="stateful"):
+            PipelineParallelWrapper(net, pipeline_mesh(2))
+
     def test_dropout_rejected(self):
         conf = (NeuralNetConfiguration.builder().seed(1).updater(Sgd(0.1))
                 .list()

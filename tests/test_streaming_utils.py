@@ -133,6 +133,31 @@ class TestBrokerSeam:
                 got, np.arange(4, dtype=np.float32).reshape(2, 2))
             remote.topic("rt").unsubscribe(c._queue)
 
+    def test_registration_consume_payload_not_dropped(self):
+        """The synchronous registration /consume can itself return a
+        message (server pre-seeded queue or a raced publish); it must
+        land on the local queue, not be discarded."""
+        from deeplearning4j_tpu.streaming.ndarray_stream import (_HttpTopic,
+                                                                 _encode)
+        topic = _HttpTopic("http://unused", "t", "cid", poll_timeout=0.05)
+        payload = _encode(np.arange(3, dtype=np.float32))
+        consumes = [0]
+
+        def fake_post(route, body):
+            if route == "/consume":
+                consumes[0] += 1
+                if consumes[0] == 1:  # the registration call
+                    return {"empty": False, **payload}
+            return {"empty": True}
+
+        topic._post = fake_post
+        q = topic.subscribe()
+        try:
+            got = q.get(timeout=5)
+            np.testing.assert_allclose(got, np.arange(3, dtype=np.float32))
+        finally:
+            topic.unsubscribe(q)
+
 
 class TestStreamingCrossProcess:
     def test_pub_sub_across_os_processes(self):
