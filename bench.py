@@ -654,14 +654,19 @@ def main():
     arg = argv[1] if len(argv) > 1 else None
 
     if once:
+        from deeplearning4j_tpu.optimize.metrics import registry
         from deeplearning4j_tpu.optimize.telemetry import CompilationTracker
         with CompilationTracker() as trk:
             metric, ips, unit, extra = run_once(workload, arg)
         # XLA compilations the measurement triggered: warm-up should own
         # them all; steady-state recompiles (ragged shapes) show up here.
+        # The full registry snapshot rides along so the BENCH artifact
+        # carries device memory, ETL splits, and step counters without a
+        # scrape endpoint (docs/observability.md).
         print(json.dumps({"metric": metric, "value": round(ips, 1),
                           "unit": unit, **extra,
-                          "xla_compilations": trk.count}))
+                          "xla_compilations": trk.count,
+                          "metrics": registry().snapshot()}))
         return
 
     # Process-level repeats in FRESH processes. With the shared compile
@@ -720,8 +725,12 @@ def main():
             sys.stderr.write(
                 f"bench: child 0 exceeded {child_limit:.0f}s with no "
                 f"completed repeat\n")
+            from deeplearning4j_tpu.optimize.metrics import registry
+            # parent-process registry: host RSS / device gauges give the
+            # post-mortem a memory picture even with zero children done
             print(json.dumps({"workload": workload, "timeout": True,
-                              "spread": {"n": 0}}))
+                              "spread": {"n": 0},
+                              "metrics": registry().snapshot()}))
             return
         lines = out.stdout.strip().splitlines()
         if out.returncode != 0 or not lines:

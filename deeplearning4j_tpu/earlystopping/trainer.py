@@ -11,6 +11,8 @@ from typing import Optional
 
 from .config import (EarlyStoppingConfiguration, EarlyStoppingResult,
                      TerminationReason)
+from ..optimize import metrics as metrics_mod
+from ..optimize import tracing
 
 log = logging.getLogger("deeplearning4j_tpu.earlystopping")
 
@@ -68,15 +70,19 @@ class EarlyStoppingTrainer:
         # fetch) when iteration conditions actually exist.
         if conf.iteration_termination_conditions:
             model.listeners.append(_IterCheck())
+        reg = metrics_mod.registry()
         try:
             while epoch < max_epochs:
                 try:
-                    self._fit_epoch()
+                    with tracing.span("earlystopping/epoch", epoch=epoch):
+                        self._fit_epoch()
                 except _StopIteration:
                     reason = TerminationReason.ITERATION_TERMINATION
                     details = stop_flag["why"]
                     break
                 epoch += 1
+                reg.counter("early_stopping_epochs_total",
+                            "Epochs completed under early stopping").inc()
 
                 # Best-model tracking and score-based termination only run
                 # on epochs where the score calculator actually ran
@@ -94,6 +100,9 @@ class EarlyStoppingTrainer:
                         best_score = score
                         best_epoch = epoch
                         conf.saver.save_best_model(model, score)
+                        reg.gauge("early_stopping_best_score",
+                                  "Best evaluation score so far"
+                                  ).set(best_score)
                 if conf.save_last_model:
                     conf.saver.save_latest_model(model, float(
                         model.score_value))

@@ -21,6 +21,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...data.dataset import DataSet, MultiDataSet
+from ...optimize import metrics as metrics_mod
+from ...optimize import tracing
 from ...utils import params as param_utils
 from ..conf.builders import BackpropType
 from ..conf.graph_conf import ComputationGraphConfiguration
@@ -211,6 +213,9 @@ class ComputationGraph(DeviceIterationMixin):
 
         # Donate params/opt/state (see MultiLayerNetwork._build_jitted).
         self._train_step_fn = jax.jit(train_step, donate_argnums=(0, 1, 2))
+        metrics_mod.register_jit_probe(
+            f"graph_train_step#{id(self) & 0xffff:04x}",
+            self._train_step_fn)
         # Unjitted step for wrappers that trace under their own context
         # (SequenceParallelWrapper) without polluting this cache.
         self._train_step_raw = train_step
@@ -394,10 +399,17 @@ class ComputationGraph(DeviceIterationMixin):
             group.clear()
 
         import time as _time
+        reg = metrics_mod.registry()
+        fit_sp = tracing.begin("fit", epochs=epochs)
         try:
             for _ in range(epochs):
+                epoch_sp = tracing.begin("epoch", epoch=self.epoch)
                 it_epoch = iter(wrapped)
                 while True:
+                    # Step span opens before the iterator poll so the
+                    # etl child nests inside it (see MultiLayerNetwork).
+                    step_sp = tracing.begin("step",
+                                            step_num=self.iteration)
                     # Track time blocked on the data pipeline (reference
                     # lastEtlTime); PerformanceListener reports it, with
                     # the producer-side host/h2d split when device
@@ -406,26 +418,53 @@ class ComputationGraph(DeviceIterationMixin):
                     try:
                         ds = next(it_epoch)
                     except StopIteration:
+                        step_sp.cancel()
                         break
-                    self.last_etl_ms = (_time.perf_counter() - t0) * 1000.0
+                    etl_s = _time.perf_counter() - t0
+                    self.last_etl_ms = etl_s * 1000.0
                     self.last_etl_host_ms = getattr(
                         ds, "_etl_host_ms", self.last_etl_ms)
                     self.last_etl_h2d_ms = getattr(ds, "_etl_h2d_ms", 0.0)
+                    tracing.add_span("etl", t0, etl_s)
                     mds = self._coerce(ds)
-                    if spd <= 1:
-                        step(mds)
-                        continue
-                    if group and group_sig(mds) != group_sig(group[0]):
+                    metrics_mod.record_etl(
+                        reg, self.last_etl_ms, self.last_etl_host_ms,
+                        self.last_etl_h2d_ms, metrics_mod.batch_rows(mds))
+                    t1 = _time.perf_counter()
+                    with tracing.span("dispatch"):
+                        if spd <= 1:
+                            step(mds)
+                        else:
+                            if group and \
+                                    group_sig(mds) != group_sig(group[0]):
+                                flush_group()
+                            group.append(mds)
+                            if len(group) >= spd:
+                                flush_group()
+                    reg.histogram(
+                        "train_step_dispatch_ms",
+                        "Host-side enqueue time per fit-loop batch "
+                        "(async: device time needs the fence)").observe(
+                            (_time.perf_counter() - t1) * 1000.0)
+                    w = tracing.fence(self.iteration, self.score_value)
+                    if w is not None:
+                        reg.gauge(
+                            "device_fence_wait_ms",
+                            "Dispatch-queue drain at the last sampled "
+                            "fence (device-compute backlog)").set(w)
+                    step_sp.end()
+                if group:
+                    with tracing.span("dispatch", flush="epoch_tail"):
                         flush_group()
-                    group.append(mds)
-                    if len(group) >= spd:
-                        flush_group()
-                flush_group()
                 self.epoch += 1
+                reg.counter("train_epochs_total",
+                            "Completed fit epochs").inc()
                 for lst in self.listeners:
                     if hasattr(lst, "on_epoch_end"):
                         lst.on_epoch_end(self, self.epoch)
+                epoch_sp.end()
         finally:
+            fit_sp.end()
             if wrapped is not iterator:
                 wrapped.shutdown()
         return self
@@ -496,6 +535,7 @@ class ComputationGraph(DeviceIterationMixin):
         (self.params_tree, self.opt_state, self.state_tree, it, self._rng,
          losses) = out
         self._iteration += steps
+        metrics_mod.record_train_step(steps)
         self._iteration_dev = it
         self._iteration_dev_mesh = None
         self.score_value = losses[-1]
@@ -609,6 +649,8 @@ class ComputationGraph(DeviceIterationMixin):
         self._commit_state(new_state)
         self._commit_iteration(new_iter, mesh)
         self.score_value = loss
+        # samples are counted at the fit-loop seam (record_etl)
+        metrics_mod.record_train_step(1)
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration)
 

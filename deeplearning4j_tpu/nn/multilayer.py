@@ -34,6 +34,8 @@ import numpy as np
 from ..data.dataset import DataSet
 from ..data.iterators import (AsyncDataSetIterator, DataSetIterator,
                               as_iterator)
+from ..optimize import metrics as metrics_mod
+from ..optimize import tracing
 from ..utils import params as param_utils
 from .conf.builders import BackpropType, MultiLayerConfiguration
 from .layers import core as core_layers
@@ -195,6 +197,8 @@ class MultiLayerNetwork(DeviceIterationMixin):
         # deep-copied at those seams so donation can never kill a shared
         # buffer.
         self._train_step_fn = jax.jit(train_step, donate_argnums=(0, 1, 2))
+        metrics_mod.register_jit_probe(
+            f"mln_train_step#{id(self) & 0xffff:04x}", self._train_step_fn)
         # Unjitted step: wrappers that must trace under their OWN context
         # (SequenceParallelWrapper's ring-attention routing) re-jit this
         # so the net's cached trace is never polluted.
@@ -379,10 +383,18 @@ class MultiLayerNetwork(DeviceIterationMixin):
             group.clear()
 
         import time as _time
+        reg = metrics_mod.registry()
+        fit_sp = tracing.begin("fit", epochs=epochs)
         try:
             for _ in range(epochs):
+                epoch_sp = tracing.begin("epoch", epoch=self.epoch)
                 it_epoch = iter(wrapped)
                 while True:
+                    # The step span opens BEFORE the iterator is polled
+                    # so its etl child nests inside it; an exhausted
+                    # iterator cancels the empty span.
+                    step_sp = tracing.begin("step",
+                                            step_num=self.iteration)
                     # Track time blocked on the data pipeline (reference
                     # lastEtlTime, MultiLayerNetwork.java:1063-1065);
                     # PerformanceListener reports it.
@@ -390,8 +402,10 @@ class MultiLayerNetwork(DeviceIterationMixin):
                     try:
                         ds = next(it_epoch)
                     except StopIteration:
+                        step_sp.cancel()
                         break
-                    self.last_etl_ms = (_time.perf_counter() - t0) * 1000.0
+                    etl_s = _time.perf_counter() - t0
+                    self.last_etl_ms = etl_s * 1000.0
                     # Device-prefetched batches carry the producer-side
                     # split: host-wait (base iterator) vs h2d-wait
                     # (device_put + transfer fence). Host-fed batches
@@ -399,20 +413,45 @@ class MultiLayerNetwork(DeviceIterationMixin):
                     self.last_etl_host_ms = getattr(
                         ds, "_etl_host_ms", self.last_etl_ms)
                     self.last_etl_h2d_ms = getattr(ds, "_etl_h2d_ms", 0.0)
-                    if spd <= 1:
-                        step(ds)
-                        continue
-                    if group and group_sig(ds) != group_sig(group[0]):
+                    tracing.add_span("etl", t0, etl_s)
+                    metrics_mod.record_etl(
+                        reg, self.last_etl_ms, self.last_etl_host_ms,
+                        self.last_etl_h2d_ms, metrics_mod.batch_rows(ds))
+                    t1 = _time.perf_counter()
+                    with tracing.span("dispatch"):
+                        if spd <= 1:
+                            step(ds)
+                        else:
+                            if group and \
+                                    group_sig(ds) != group_sig(group[0]):
+                                flush_group()
+                            group.append(ds)
+                            if len(group) >= spd:
+                                flush_group()
+                    reg.histogram(
+                        "train_step_dispatch_ms",
+                        "Host-side enqueue time per fit-loop batch "
+                        "(async: device time needs the fence)").observe(
+                            (_time.perf_counter() - t1) * 1000.0)
+                    w = tracing.fence(self.iteration, self.score_value)
+                    if w is not None:
+                        reg.gauge(
+                            "device_fence_wait_ms",
+                            "Dispatch-queue drain at the last sampled "
+                            "fence (device-compute backlog)").set(w)
+                    step_sp.end()
+                if group:  # end of epoch: run the partial group
+                    with tracing.span("dispatch", flush="epoch_tail"):
                         flush_group()
-                    group.append(ds)
-                    if len(group) >= spd:
-                        flush_group()
-                flush_group()  # end of epoch: run the partial group
                 self.epoch += 1
+                reg.counter("train_epochs_total",
+                            "Completed fit epochs").inc()
                 for lst in self.listeners:
                     if hasattr(lst, "on_epoch_end"):
                         lst.on_epoch_end(self, self.epoch)
+                epoch_sp.end()
         finally:
+            fit_sp.end()
             if isinstance(wrapped, AsyncDataSetIterator):
                 wrapped.shutdown()
         return self
@@ -506,6 +545,7 @@ class MultiLayerNetwork(DeviceIterationMixin):
          losses) = out
         events = steps if listener_events is None else listener_events
         self._iteration += steps
+        metrics_mod.record_train_step(steps)
         self._iteration_dev = it
         self._iteration_dev_mesh = None
         self.score_value = losses[-1]
@@ -653,6 +693,9 @@ class MultiLayerNetwork(DeviceIterationMixin):
         self._commit_state(new_state)
         self._commit_iteration(new_iter, mesh)
         self.score_value = loss
+        # samples are counted at the fit-loop seam (record_etl), never
+        # here — the wrapper's sharded path funnels through both
+        metrics_mod.record_train_step(1)
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration)
 

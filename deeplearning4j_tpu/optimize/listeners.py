@@ -52,12 +52,21 @@ class PerformanceListener(IterationListener):
     h2d-wait when the device prefetcher is active, and each report
     carries the XLA compilations observed since the previous one — a
     nonzero count at steady state is the recompile-per-shape bug
-    pad-to-bucket exists to kill (docs/perf_data_pipeline.md)."""
+    pad-to-bucket exists to kill (docs/perf_data_pipeline.md). The
+    compile count and ETL numbers come FROM the metrics registry /
+    model, never recomputed here, and every report writes throughput +
+    score back INTO the registry (docs/observability.md) so a /metrics
+    scrape and this log line can never disagree.
+
+    `fence=False` skips the score fetch: timings are then DISPATCH-SIDE
+    only (jax async dispatch returns before the device finishes — the
+    TPU caveat above), but the listener adds zero synchronization."""
 
     def __init__(self, frequency: int = 10, report_samples: bool = True,
-                 printer=None):
+                 printer=None, fence: bool = True):
         self.frequency = max(1, int(frequency))
         self.report_samples = report_samples
+        self.fence = bool(fence)
         self._printer = printer or (lambda msg: log.info("%s", msg))
         self._last_time: Optional[float] = None
         self._last_iter: Optional[int] = None
@@ -71,8 +80,16 @@ class PerformanceListener(IterationListener):
     def iteration_done(self, model, iteration):
         if iteration % self.frequency != 0:
             return
-        float(model.score_value)  # fence: measure real device time
+        from .metrics import registry
         from .telemetry import compilation_count
+        reg = registry()
+        if self.fence:
+            # fence: measure real device time, and publish the score
+            # (the registry's train_score only updates on fenced reads
+            # — nothing else may sync the dispatch queue)
+            reg.gauge("train_score",
+                      "Loss at the last fenced report").set(
+                          float(model.score_value))
         compiles = compilation_count()
         now = time.perf_counter()
         if self._last_time is not None and iteration > self._last_iter:
@@ -80,8 +97,17 @@ class PerformanceListener(IterationListener):
             iters = iteration - self._last_iter
             msg = (f"iteration {iteration}: {iters / dt:.2f} batches/sec, "
                    f"{dt / iters * 1000:.1f} ms/iter")
+            reg.gauge("train_batches_per_sec",
+                      "Throughput at the last report").set(iters / dt)
+            reg.gauge("train_ms_per_iter",
+                      "Wall ms per optimizer step at the last report"
+                      ).set(dt / iters * 1000)
             if self.report_samples and self._last_batch_size:
-                msg += f", {iters * self._last_batch_size / dt:.1f} samples/sec"
+                sps = iters * self._last_batch_size / dt
+                msg += f", {sps:.1f} samples/sec"
+                reg.gauge("train_samples_per_sec",
+                          "Example throughput at the last report"
+                          ).set(sps)
             etl = getattr(model, "last_etl_ms", None)
             if etl is not None:
                 msg += f", etl {etl:.2f} ms"
@@ -93,6 +119,8 @@ class PerformanceListener(IterationListener):
                 if self._last_compiles is not None else 0
             if self.last_compile_delta:
                 msg += f", {self.last_compile_delta} xla compilations"
+            if not self.fence:
+                msg += " [dispatch-side]"
             self._printer(msg)
         self._last_time = now
         self._last_iter = iteration

@@ -1,0 +1,461 @@
+"""Process-global metrics registry: the single source of truth for
+training telemetry.
+
+The reference routes every number through BaseStatsListener → StatsStorage
+and renders it in the Play UI; here the scattered fragments (compile
+counts in telemetry.py, throughput strings in PerformanceListener, RSS
+snapshots in ui/stats.py) fold into ONE thread-safe registry of labeled
+Counter / Gauge / Histogram families, exported two ways:
+
+* `registry().prometheus_text()` — Prometheus text exposition format
+  (`GET /metrics` on the UI server scrapes this).
+* `registry().snapshot()` — a flat {name{labels}: value} dict, embedded
+  in bench.py's BENCH JSON so a timed-out run still leaves telemetry
+  behind.
+
+Device visibility: a runtime collector samples
+`jax.local_devices()[i].memory_stats()` at scrape time into per-device
+`device_bytes_in_use` / `device_peak_bytes_in_use` gauges (0 on
+backends that expose no stats, e.g. CPU), plus host RSS with the
+platform-correct `ru_maxrss` units (KiB on Linux, BYTES on Darwin —
+the 1024× bug this helper exists to kill).
+
+Overhead: a counter bump is a dict lookup + lock; sampling (devices,
+jit caches) happens only at scrape/snapshot time, never in the step
+loop. Nothing here fences the device.
+"""
+from __future__ import annotations
+
+import resource
+import sys
+import threading
+import time
+import weakref
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
+    "host_rss_bytes", "device_memory_stats", "record_train_step",
+    "register_jit_probe",
+]
+
+# Invalid label/metric characters are the caller's problem — names here
+# are all code-authored. Prometheus escaping rules for label VALUES are
+# applied on export (backslash, quote, newline).
+_LABEL_ESCAPES = {"\\": "\\\\", '"': '\\"', "\n": "\\n"}
+
+
+def _escape_label(v: str) -> str:
+    return "".join(_LABEL_ESCAPES.get(c, c) for c in str(v))
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+def _label_str(key: Tuple[Tuple[str, str], ...]) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label(v)}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+class _Family:
+    """One named metric family; children keyed by their label set.
+    Unlabeled use (`family.inc()`) operates on the empty-label child."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, lock: threading.RLock):
+        self.name = name
+        self.help = help
+        self._lock = lock
+        self._children: Dict[Tuple[Tuple[str, str], ...], "_Family"] = {}
+        self._value = 0.0
+
+    def labels(self, **labels) -> "_Family":
+        key = _label_key(labels)
+        if not key:
+            return self
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = type(self)(self.name, self.help, self._lock)
+                self._children[key] = child
+            return child
+
+    # ---- iteration over (label_key, child) incl. the bare child --------
+    def _cells(self):
+        with self._lock:
+            items = list(self._children.items())
+        out = []
+        if not items or self._touched():
+            out.append(((), self))
+        out.extend(items)
+        return out
+
+    def _touched(self) -> bool:
+        return not self._children  # bare families always export
+
+    def value(self, **labels) -> float:
+        child = self.labels(**labels)
+        with self._lock:
+            return child._value
+
+
+class Counter(_Family):
+    """Monotonic counter (Prometheus counter semantics)."""
+
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up")
+        with self._lock:
+            self._value += amount
+
+
+class Gauge(_Family):
+    """Set-anytime value (scores, queue depths, memory bytes)."""
+
+    kind = "gauge"
+
+    def __init__(self, name, help, lock):
+        super().__init__(name, help, lock)
+        self._set = False
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+            self._set = True
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+            self._set = True
+
+    def _touched(self) -> bool:
+        return self._set or not self._children
+
+
+DEFAULT_BUCKETS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                   500.0, 1000.0, 2500.0, 10000.0)
+
+
+class Histogram(_Family):
+    """Cumulative-bucket histogram (Prometheus histogram exposition:
+    `_bucket{le=...}`, `_sum`, `_count`)."""
+
+    kind = "histogram"
+
+    def __init__(self, name, help, lock,
+                 buckets: Iterable[float] = DEFAULT_BUCKETS):
+        super().__init__(name, help, lock)
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        self._counts = [0] * (len(self.buckets) + 1)  # +inf tail
+        self._sum = 0.0
+        self._n = 0
+
+    def labels(self, **labels) -> "Histogram":
+        key = _label_key(labels)
+        if not key:
+            return self
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = Histogram(self.name, self.help, self._lock,
+                                  self.buckets)
+                self._children[key] = child
+            return child
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        with self._lock:
+            self._sum += v
+            self._n += 1
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    def _touched(self) -> bool:
+        return self._n > 0 or not self._children
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._n
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+
+class MetricsRegistry:
+    """Thread-safe named-family registry with pluggable collectors
+    (callbacks run before every export/snapshot to sample lazy sources:
+    device memory, host RSS, jit caches)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._families: Dict[str, _Family] = {}
+        self._collectors: List[Callable[["MetricsRegistry"], None]] = []
+
+    # ------------------------------------------------------- registration
+    def _family(self, cls, name: str, help: str, **kw) -> _Family:
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = cls(name, help, self._lock, **kw)
+                self._families[name] = fam
+            elif not isinstance(fam, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {fam.kind}")
+            return fam
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._family(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._family(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Iterable[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._family(Histogram, name, help, buckets=buckets)
+
+    def register_collector(self, fn: Callable[["MetricsRegistry"], None]):
+        with self._lock:
+            self._collectors.append(fn)
+        return fn
+
+    def collect(self) -> None:
+        with self._lock:
+            collectors = list(self._collectors)
+        for fn in collectors:
+            try:
+                fn(self)
+            except Exception:
+                pass  # a broken sampler must never fail a scrape
+
+    # ------------------------------------------------------------ export
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        self.collect()
+        with self._lock:
+            families = sorted(self._families.values(),
+                              key=lambda f: f.name)
+        lines: List[str] = []
+        for fam in families:
+            if fam.help:
+                lines.append(f"# HELP {fam.name} {fam.help}")
+            lines.append(f"# TYPE {fam.name} {fam.kind}")
+            for key, child in fam._cells():
+                if isinstance(child, Histogram):
+                    cum = 0
+                    for b, c in zip(child.buckets, child._counts):
+                        cum += c
+                        bkey = key + (("le", _fmt(b)),)
+                        lines.append(
+                            f"{fam.name}_bucket{_label_str(bkey)} {cum}")
+                    cum += child._counts[-1]
+                    ikey = key + (("le", "+Inf"),)
+                    lines.append(
+                        f"{fam.name}_bucket{_label_str(ikey)} {cum}")
+                    lines.append(
+                        f"{fam.name}_sum{_label_str(key)} "
+                        f"{_fmt(child._sum)}")
+                    lines.append(
+                        f"{fam.name}_count{_label_str(key)} {child._n}")
+                else:
+                    lines.append(
+                        f"{fam.name}{_label_str(key)} "
+                        f"{_fmt(child._value)}")
+        return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> Dict[str, float]:
+        """Flat {name{labels}: value}; histograms contribute _count and
+        _sum. The bench-JSON embedding format."""
+        self.collect()
+        out: Dict[str, float] = {}
+        with self._lock:
+            families = sorted(self._families.values(),
+                              key=lambda f: f.name)
+        for fam in families:
+            for key, child in fam._cells():
+                ls = _label_str(key)
+                if isinstance(child, Histogram):
+                    out[f"{fam.name}_count{ls}"] = child._n
+                    out[f"{fam.name}_sum{ls}"] = round(child._sum, 3)
+                else:
+                    out[f"{fam.name}{ls}"] = round(child._value, 6)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Runtime samplers (host RSS, device HBM, jit caches)
+# ---------------------------------------------------------------------------
+def host_rss_bytes() -> float:
+    """Peak resident set size in BYTES. getrusage reports ru_maxrss in
+    KiB on Linux but BYTES on macOS — the unit branch lives here so no
+    caller is ever 1024× off again."""
+    ru = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    return float(ru) if sys.platform == "darwin" else float(ru) * 1024.0
+
+
+def device_memory_stats() -> List[Dict[str, float]]:
+    """Per-device {device, bytes_in_use, peak_bytes_in_use} sampled from
+    jax.local_devices(); 0s where the backend exposes no memory_stats()
+    (CPU). Shared by the scrape collector and StatsListener so the
+    sampling logic has exactly one implementation."""
+    try:
+        import jax
+        devices = jax.local_devices()
+    except Exception:
+        return []
+    out = []
+    for d in devices:
+        try:
+            stats = d.memory_stats() or {}
+        except Exception:
+            stats = {}
+        out.append({
+            "device": str(d),
+            "bytes_in_use": float(stats.get("bytes_in_use", 0) or 0),
+            "peak_bytes_in_use": float(
+                stats.get("peak_bytes_in_use", 0) or 0),
+        })
+    return out
+
+
+# jit-cache probes: (label, weakref-to-jitted-fn); sampled at scrape time
+# so dead networks drop out and the hot loop never touches them.
+_jit_probes: List[Tuple[str, "weakref.ref"]] = []
+_jit_lock = threading.Lock()
+
+
+def register_jit_probe(label: str, fn) -> None:
+    """Expose `jit_cache_size{fn=label}` for one jax.jit callable (the
+    per-shape compile count regression tests pin). Weakly referenced:
+    the probe dies with its network."""
+    try:
+        ref = weakref.ref(fn)
+    except TypeError:
+        return
+    with _jit_lock:
+        # replace a dead or same-labeled probe rather than accumulate
+        _jit_probes[:] = [(l, r) for l, r in _jit_probes
+                          if r() is not None and l != label]
+        _jit_probes.append((label, ref))
+
+
+def _sample_runtime(reg: MetricsRegistry) -> None:
+    reg.gauge("host_rss_bytes",
+              "Peak host resident set size (platform-correct units)"
+              ).set(host_rss_bytes())
+    g_use = reg.gauge("device_bytes_in_use",
+                      "Device (HBM) bytes currently allocated; 0 when "
+                      "the backend exposes no memory_stats")
+    g_peak = reg.gauge("device_peak_bytes_in_use",
+                       "Peak device (HBM) bytes allocated; 0 when the "
+                       "backend exposes no memory_stats")
+    for d in device_memory_stats():
+        g_use.labels(device=d["device"]).set(d["bytes_in_use"])
+        g_peak.labels(device=d["device"]).set(d["peak_bytes_in_use"])
+    with _jit_lock:
+        probes = list(_jit_probes)
+    if probes:
+        from .telemetry import jit_cache_size
+        g = reg.gauge("jit_cache_size",
+                      "Compiled-executable cache size per jitted fn "
+                      "(-1: no probe on this jax version)")
+        for label, ref in probes:
+            fn = ref()
+            if fn is not None:
+                g.labels(fn=label).set(jit_cache_size(fn))
+
+
+# ---------------------------------------------------------------------------
+# Process-global registry
+# ---------------------------------------------------------------------------
+_registry: Optional[MetricsRegistry] = None
+_registry_lock = threading.Lock()
+
+
+def registry() -> MetricsRegistry:
+    """The process-global registry (created on first use, with the
+    runtime samplers installed)."""
+    global _registry
+    if _registry is None:
+        with _registry_lock:
+            if _registry is None:
+                reg = MetricsRegistry()
+                reg.register_collector(_sample_runtime)
+                reg.gauge("process_start_time_seconds",
+                          "Unix time this registry was created"
+                          ).set(time.time())
+                _registry = reg
+                # Attach the compile-event listener NOW (it feeds the
+                # registry's xla_compilations_total) so a scrape sees the
+                # family even before anyone asks for the count. After
+                # _registry is set — telemetry calls back into registry().
+                from . import telemetry
+                telemetry.compilation_count()
+    return _registry
+
+
+def record_train_step(steps: int = 1, samples: int = 0) -> None:
+    """One-call hot-loop hook for the networks' commit paths: bumps
+    train_iterations_total (and train_samples_total when the caller
+    knows the batch rows). Shape metadata only — never touches device
+    values, so it can never fence."""
+    reg = registry()
+    reg.counter("train_iterations_total",
+                "Optimizer steps taken (all networks)").inc(steps)
+    if samples:
+        reg.counter("train_samples_total",
+                    "Training examples consumed").inc(samples)
+
+
+def record_etl(reg: MetricsRegistry, etl_ms: float, host_ms: float,
+               h2d_ms: float, samples: int = 0) -> None:
+    """Per-batch data-pipeline wait (the fit loops' lastEtlTime signal),
+    host/h2d split included."""
+    reg.gauge("etl_ms", "Data-pipeline wait for the last batch"
+              ).set(etl_ms)
+    reg.gauge("etl_host_ms",
+              "Host-side (producer) share of the last ETL wait"
+              ).set(host_ms)
+    reg.gauge("etl_h2d_ms",
+              "Host-to-device transfer share of the last ETL wait"
+              ).set(h2d_ms)
+    reg.histogram("etl_wait_ms",
+                  "Distribution of per-batch data-pipeline waits"
+                  ).observe(etl_ms)
+    if samples:
+        reg.counter("train_samples_total",
+                    "Training examples consumed").inc(samples)
+
+
+def batch_rows(ds) -> int:
+    """Batch size of a DataSet / MultiDataSet from shape METADATA only
+    (np.asarray on a device-resident batch would d2h-copy in the hot
+    loop)."""
+    try:
+        f = getattr(ds, "features", None)
+        if f is None:
+            return 0
+        if isinstance(f, (list, tuple)):
+            f = f[0] if f else None
+        shape = getattr(f, "shape", None)
+        return int(shape[0]) if shape else 0
+    except Exception:
+        return 0

@@ -22,11 +22,14 @@ is that compilations stop happening.
 """
 from __future__ import annotations
 
+import logging
 import threading
 
+log = logging.getLogger(__name__)
+
 _lock = threading.Lock()
-_compile_count = 0
 _listening = False
+_warned_no_monitoring = False
 
 # The event jax records around every backend (XLA) compilation; stable
 # across recent jax versions. Matching on the suffix keeps us robust to
@@ -34,15 +37,23 @@ _listening = False
 _COMPILE_EVENT_SUFFIX = "backend_compile_duration"
 
 
+def _counter():
+    # The registry is the single source of truth for the count; this
+    # module owns registration + the snapshot-delta ergonomics.
+    from .metrics import registry
+    return registry().counter(
+        "xla_compilations_total",
+        "Backend (XLA) compilations observed by the jax.monitoring "
+        "listener")
+
+
 def _on_event(event: str, duration: float, **_kw) -> None:
-    global _compile_count
     if event.endswith(_COMPILE_EVENT_SUFFIX):
-        with _lock:
-            _compile_count += 1
+        _counter().inc()
 
 
 def _ensure_listener() -> bool:
-    global _listening
+    global _listening, _warned_no_monitoring
     if _listening:
         return True
     with _lock:
@@ -51,18 +62,27 @@ def _ensure_listener() -> bool:
         try:
             from jax import monitoring
             monitoring.register_event_duration_secs_listener(_on_event)
-        except Exception:
-            return False  # jax without monitoring: counters stay at 0
+        except Exception as e:
+            # One-shot and LOUD: without this, a zero compile count is
+            # indistinguishable from "listener never attached".
+            if not _warned_no_monitoring:
+                _warned_no_monitoring = True
+                log.warning(
+                    "jax.monitoring unavailable (%s): XLA compilation "
+                    "counters will read 0 — compile-count telemetry is "
+                    "OFF, not quiet", e)
+            return False
         _listening = True
     return True
 
 
 def compilation_count() -> int:
     """Process-global backend compilations observed since the listener
-    registered (monotonic; meaningful as deltas)."""
+    registered (monotonic; meaningful as deltas). Reads the registry's
+    `xla_compilations_total` counter — one source of truth with the
+    `/metrics` scrape."""
     _ensure_listener()
-    with _lock:
-        return _compile_count
+    return int(_counter().value())
 
 
 class CompilationTracker:

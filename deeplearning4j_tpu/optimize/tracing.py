@@ -1,0 +1,232 @@
+"""Nestable span tracing over the training loop, with a Chrome
+trace-event exporter.
+
+The fit loops emit the span taxonomy `fit / epoch / step /
+{etl, dispatch, device}` (docs/observability.md). Spans are
+`time.perf_counter` intervals recorded into a bounded ring buffer —
+O(1) memory however long training runs — and export as Chrome
+trace-event-format JSON (`ph:"X"` complete events; load in
+chrome://tracing or Perfetto), also served live at `GET /trace` on the
+UI server.
+
+Three design points keep steady-state overhead negligible:
+
+* Disabled (the default), `span()` returns a shared no-op context
+  manager: one branch per call site, nothing recorded.
+* jax dispatch is async, so a `dispatch` span measures host-side
+  enqueue time only. The sampled FENCE (`fence(step, value)`, every
+  `fence_every`-th step) calls `jax.block_until_ready` and records the
+  wait as a `device` span — the dispatch-side vs device-compute split.
+  block_until_ready adds no computation and no compilation, so the
+  1-compile-per-epoch invariant and numerics are untouched.
+* `annotate=True` additionally enters `jax.profiler.TraceAnnotation`
+  (and `StepTraceAnnotation` for spans carrying a `step_num` arg) so
+  spans line up with XLA activity in a real profiler capture.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Optional
+
+__all__ = ["enable", "disable", "is_enabled", "clear", "span", "begin",
+           "add_span", "fence", "export_trace_events", "dump",
+           "DEFAULT_FENCE_EVERY"]
+
+# Default fence sampling once tracing is enabled: 1 fenced step in 16
+# bounds the pipelining loss to ~1/16 of one step's dispatch-ahead.
+# With tracing disabled there is NO fencing at all.
+DEFAULT_FENCE_EVERY = 16
+
+_lock = threading.Lock()
+_enabled = False
+_annotate = False
+_fence_every = 0
+_ring: deque = deque(maxlen=4096)
+
+
+class _NullSpan:
+    """Reusable no-op: the disabled-path return of span()/begin()."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def end(self):
+        pass
+
+    def cancel(self):
+        pass
+
+
+_NULL = _NullSpan()
+
+
+class Span:
+    """One live interval; use as a context manager or via begin()/end().
+    cancel() discards it (a `step` span opened before the iterator
+    reported exhaustion)."""
+
+    __slots__ = ("name", "args", "_t0", "_ann", "_done")
+
+    def __init__(self, name: str, args: Dict[str, Any]):
+        self.name = name
+        self.args = args
+        self._ann = None
+        self._done = False
+        if _annotate:
+            self._ann = _make_annotation(name, args)
+            if self._ann is not None:
+                self._ann.__enter__()
+        self._t0 = time.perf_counter()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+    def end(self):
+        if self._done:
+            return
+        self._done = True
+        dur = time.perf_counter() - self._t0
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+        _record(self.name, self._t0, dur, self.args)
+
+    def cancel(self):
+        if self._done:
+            return
+        self._done = True
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+
+
+def _make_annotation(name: str, args: Dict[str, Any]):
+    try:
+        from jax import profiler
+        if "step_num" in args and hasattr(profiler, "StepTraceAnnotation"):
+            return profiler.StepTraceAnnotation(
+                name, step_num=int(args["step_num"]))
+        return profiler.TraceAnnotation(name)
+    except Exception:
+        return None
+
+
+def _record(name: str, t0: float, dur: float,
+            args: Optional[Dict[str, Any]]):
+    ev = {"name": name, "ts": t0 * 1e6, "dur": dur * 1e6,
+          "tid": threading.get_ident()}
+    if args:
+        ev["args"] = args
+    _ring.append(ev)  # deque.append is atomic; maxlen bounds memory
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+def enable(ring_size: int = 4096, annotate: bool = False,
+           fence_every: int = DEFAULT_FENCE_EVERY) -> None:
+    """Turn tracing on. `fence_every=0` disables the sampled device
+    fence (dispatch-side timings only); `annotate=True` mirrors spans
+    into jax.profiler annotations."""
+    global _enabled, _annotate, _fence_every, _ring
+    with _lock:
+        _ring = deque(_ring, maxlen=int(ring_size))
+        _annotate = bool(annotate)
+        _fence_every = max(0, int(fence_every))
+        _enabled = True
+
+
+def disable() -> None:
+    global _enabled, _annotate, _fence_every
+    with _lock:
+        _enabled = False
+        _annotate = False
+        _fence_every = 0
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+def clear() -> None:
+    _ring.clear()
+
+
+def span(name: str, **args):
+    """Context manager for one interval; no-op (shared singleton) when
+    tracing is disabled."""
+    if not _enabled:
+        return _NULL
+    return Span(name, args)
+
+
+def begin(name: str, **args):
+    """Explicitly-ended span for intervals that cannot nest lexically
+    (the step span opened before the iterator is polled)."""
+    if not _enabled:
+        return _NULL
+    return Span(name, args)
+
+
+def add_span(name: str, start: float, dur_s: float, **args) -> None:
+    """Record a retroactive span from an already-measured interval
+    (`start` in time.perf_counter seconds): the fit loops time ETL with
+    perf_counter anyway, so the span costs nothing extra."""
+    if not _enabled:
+        return
+    _record(name, start, dur_s, args or None)
+
+
+def fence(step: int, value) -> Optional[float]:
+    """Sampled dispatch-queue drain: every `fence_every`-th step, block
+    until `value` (typically the committed loss) is device-complete and
+    record the wait as a `device` span. Returns the wait in ms when it
+    ran, else None. No-op when tracing is off or fence_every == 0."""
+    if not _enabled or _fence_every <= 0 or value is None:
+        return None
+    if step % _fence_every != 0:
+        return None
+    t0 = time.perf_counter()
+    try:
+        import jax
+        jax.block_until_ready(value)
+    except Exception:
+        return None
+    dur = time.perf_counter() - t0
+    _record("device", t0, dur, {"step": int(step)})
+    return dur * 1000.0
+
+
+def export_trace_events() -> Dict[str, Any]:
+    """Chrome trace-event-format dict: {"traceEvents": [...],
+    "displayTimeUnit": "ms"}. Events are ph:"X" completes; nesting is
+    derived by the viewer from ts/dur containment per tid."""
+    pid = os.getpid()
+    events = []
+    for ev in list(_ring):
+        out = {"name": ev["name"], "ph": "X", "pid": pid,
+               "tid": ev["tid"], "ts": round(ev["ts"], 3),
+               "dur": round(ev["dur"], 3), "cat": "train"}
+        if "args" in ev:
+            out["args"] = ev["args"]
+        events.append(out)
+    events.sort(key=lambda e: e["ts"])
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def dump(path: str) -> str:
+    """Write the current ring as trace-event JSON; returns the path."""
+    with open(path, "w") as f:
+        json.dump(export_trace_events(), f)
+    return path

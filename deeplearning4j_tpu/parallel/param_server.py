@@ -39,6 +39,7 @@ import numpy as np
 from ..data.iterators import as_iterator
 from ..nn.multilayer import MultiLayerNetwork
 from ..nn.updaters import normalize_layer_gradients
+from ..optimize import metrics as metrics_mod
 
 log = logging.getLogger(__name__)
 
@@ -115,9 +116,13 @@ class ParameterServer:
         captured under the SAME lock acquisition — reading
         `server.version` after push() returns can observe a different
         concurrent push's version."""
+        pushes = metrics_mod.registry().counter(
+            "param_server_pushes_total",
+            "Gradient pushes by outcome (applied vs dropped as stale)")
         with self._lock:
             if self.version - version > self.max_staleness:
                 self.stale_drops += 1
+                pushes.labels(result="stale_drop").inc()
                 return False, self.version
             grads = jax.device_put(grads, self.device)
             self.params, self.opt_state = self._apply(
@@ -125,6 +130,7 @@ class ParameterServer:
                 jnp.asarray(self.version, jnp.int32), grads)
             self.version += 1
             self.applied += 1
+            pushes.labels(result="applied").inc()
             return True, self.version
 
 
@@ -185,6 +191,10 @@ class ParameterServerTrainer:
         dev = self.devices[wid]
         rng = jax.random.PRNGKey(1000 + wid)
         state = jax.device_put(self.net.state_tree, dev)
+        steps = metrics_mod.registry().counter(
+            "param_server_worker_steps_total",
+            "Applied async-SGD steps per worker thread"
+            ).labels(worker=str(wid))
         try:
             while not stop.is_set():
                 try:
@@ -201,6 +211,7 @@ class ParameterServerTrainer:
                                                 *data)
                     if self.server.push(version, grads):
                         self.losses.append(float(loss))
+                        steps.inc()
                         break
                     # dropped as stale: re-pull fresh params and redo
         except Exception as e:  # surfaced by fit(); a dead worker must

@@ -39,6 +39,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import mesh as mesh_lib
 from ..nn.multilayer import _regularization_score
+from ..optimize import metrics as metrics_mod
 
 log = logging.getLogger(__name__)
 
@@ -301,6 +302,12 @@ class PipelineParallelWrapper:
                 y_mb)
         net._commit_iteration(new_iter, self.mesh)
         net.score_value = loss
+        metrics_mod.registry().counter(
+            "pipeline_steps_total",
+            "GPipe-scheduled optimizer steps (stage/microbatch-labeled)"
+            ).labels(stages=str(self.stages),
+                     microbatches=str(self.n_microbatches)).inc()
+        metrics_mod.record_train_step(1)
         for lst in net.listeners:
             lst.iteration_done(net, net.iteration)
 

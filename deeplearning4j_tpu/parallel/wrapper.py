@@ -40,6 +40,7 @@ from . import mesh as mesh_lib
 # historical names stay importable from here (sequence.py does).
 from ..data.padding import pad_lmask_zero_weight, repeat_tail_rows  # noqa: F401
 from ..nn.layers.recurrent import RECURRENT_CARRY_KEYS
+from ..optimize import metrics as metrics_mod
 
 log = logging.getLogger(__name__)
 
@@ -205,6 +206,10 @@ class ParallelWrapper:
         if self.averaging_frequency > 1:
             self._local_round(ds)
             return
+        metrics_mod.registry().counter(
+            "data_parallel_steps_total",
+            "ParallelWrapper optimizer steps by mode"
+            ).labels(mode="sync", workers=str(self.data_shards)).inc()
         if not self._placed:
             net._check_init()
             self._place_model()
@@ -266,6 +271,28 @@ class ParallelWrapper:
             self._shard_arr(fmask), self._shard_arr(lmask), mesh=self.mesh)
 
     # ----------------------------------------------------- local SGD (freq>1)
+    def _mark_local_step(self):
+        """Telemetry for one local-SGD round: every replica took one
+        independent step (worker-labeled, the reference's per-trainer
+        iteration counters), and the nets' commit paths were bypassed so
+        the global iteration counter is bumped here."""
+        reg = metrics_mod.registry()
+        c = reg.counter("data_parallel_worker_steps_total",
+                        "Local-SGD steps per replica (worker-labeled)")
+        for w in range(self.data_shards):
+            c.labels(worker=str(w)).inc()
+        reg.counter("data_parallel_steps_total",
+                    "ParallelWrapper optimizer steps by mode"
+                    ).labels(mode="local_sgd",
+                             workers=str(self.data_shards)).inc()
+        metrics_mod.record_train_step(1)
+
+    def _mark_average(self):
+        metrics_mod.registry().counter(
+            "data_parallel_averages_total",
+            "Parameter averages across replicas (averageAndPropagate)"
+            ).labels(workers=str(self.data_shards)).inc()
+
     def _build_local_machinery(self, n_data_args: int):
         """Jitted helpers for the replica-stacked representation."""
         from jax.sharding import NamedSharding, PartitionSpec
@@ -445,9 +472,11 @@ class ParallelWrapper:
         self._since_avg += 1
         net.iteration += 1
         net.score_value = jnp.mean(losses)
+        self._mark_local_step()
         if self._since_avg >= self.averaging_frequency:
             self._stacked = self._jit_helpers["avg"](self._stacked)
             self._since_avg = 0
+            self._mark_average()
         # Sync the canonical trees every round (post-average they hold the
         # averaged values; mid-window, replica 0's — the per-worker view a
         # reference listener would see), so Checkpoint/Evaluative listeners
@@ -507,10 +536,12 @@ class ParallelWrapper:
             self._since_avg += 1
             net.iteration += 1
             net.score_value = jnp.mean(losses)
+            self._mark_local_step()
             if self._since_avg >= self.averaging_frequency:
                 self._stacked = self._jit_helpers["avg_keep_carry"](
                     self._stacked)
                 self._since_avg = 0
+                self._mark_average()
             self._sync_net_from_stacked()
             for lst in net.listeners:
                 lst.iteration_done(net, net.iteration)
@@ -568,10 +599,12 @@ class ParallelWrapper:
             self._since_avg += 1
             net.iteration += 1
             net.score_value = jnp.mean(losses)
+            self._mark_local_step()
             if self._since_avg >= self.averaging_frequency:
                 self._stacked = self._jit_helpers["avg_keep_carry"](
                     self._stacked)
                 self._since_avg = 0
+                self._mark_average()
             self._sync_net_from_stacked()
             for lst in net.listeners:
                 lst.iteration_done(net, net.iteration)
@@ -605,6 +638,7 @@ class ParallelWrapper:
         refresh the net's canonical (unstacked) trees."""
         self._stacked = self._jit_helpers["avg"](self._stacked)
         self._since_avg = 0
+        self._mark_average()
         self._sync_net_from_stacked()
 
     def finalize(self):

@@ -139,6 +139,9 @@ def render_html(storage: StatsStorage, session_id: str = None,
                     f'content="{refresh_seconds:g}">'
                     if refresh_seconds else "")
     live_note = " (live)" if refresh_seconds else ""
+    # hoisted out of the f-string: a backslash inside an f-string
+    # expression is a SyntaxError before Python 3.12
+    stats_json = export_json(storage, sid).replace("<", "\\u003c")
     return f"""<!doctype html>
 <html><head><meta charset="utf-8">{meta_refresh}
 <title>Training report — {html.escape(sid)}</title>
@@ -157,7 +160,7 @@ border-bottom:1px solid #eee}}h2{{margin-top:1.4em}}
 {hist_section}
 {upd_section}
 <script type="application/json" id="stats-data">
-{export_json(storage, sid).replace("<", "\\u003c")}
+{stats_json}
 </script>
 </body></html>"""
 

@@ -15,18 +15,25 @@ Routes:
   GET /                  live HTML overview (self-refreshing)
   GET /train/sessions    JSON session ids
   GET /train/data        JSON all updates of the newest session
+  GET /metrics           Prometheus text exposition of the process-global
+                         MetricsRegistry (docs/observability.md)
+  GET /trace             Chrome trace-event JSON of the tracing ring
+                         (load in chrome://tracing / Perfetto)
   GET /tsne              embedding scatter plot (attach_embedding /
                          POST /tsne/upload — the tsne UI module role)
   POST /tsne/upload      {"points": [[x,y],...], "labels": [...]}
 """
 from __future__ import annotations
 
+import json
 import threading
 import zlib
 from typing import Optional, Sequence
 
 import numpy as np
 
+from ..optimize import metrics as metrics_mod
+from ..optimize import tracing
 from ..utils.http_server import JsonHttpServer
 from .report import render_html
 from .stats import StatsStorage
@@ -86,7 +93,9 @@ class UIServer:
             post_routes={"/tsne/upload": self._tsne_upload},
             raw_get_routes={"/": self._index, "/tsne": self._tsne_page,
                             "/model": self._model_page,
-                            "/activations": self._activations_page},
+                            "/activations": self._activations_page,
+                            "/metrics": self._metrics,
+                            "/trace": self._trace},
             port=port)
 
     # ----------------------------------------------------------- lifecycle
@@ -170,6 +179,19 @@ class UIServer:
         if st is None:
             return 404, {"error": "no attached session"}
         return 200, {"session": sid, "updates": st.get_updates(sid)}
+
+    # ------------------------------------------------- observability scrape
+    def _metrics(self):
+        """Prometheus scrape target: the process-global registry, so one
+        endpoint covers every network/wrapper in the process."""
+        body = metrics_mod.registry().prometheus_text().encode()
+        return 200, "text/plain; version=0.0.4; charset=utf-8", body
+
+    def _trace(self):
+        """Chrome trace-event JSON of the span ring (empty traceEvents
+        list until tracing.enable() has been called)."""
+        body = json.dumps(tracing.export_trace_events()).encode()
+        return 200, "application/json", body
 
     # --------------------------------------------------------- flow module
     def attach_model(self, net) -> "UIServer":

@@ -14,12 +14,12 @@ from __future__ import annotations
 
 import json
 import os
-import resource
 import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from ..optimize import metrics as metrics_mod
 from ..optimize.listeners import IterationListener
 
 
@@ -162,16 +162,13 @@ class StatsListener(IterationListener):
         if cfg.collect_timings and duration_ms is not None:
             rec["iteration_ms"] = duration_ms
         if cfg.collect_memory:
-            # ru_maxrss: KiB on linux — host-side RSS (the JVM-heap analog)
+            # host-side RSS, the JVM-heap analog; host_rss_bytes handles
+            # the ru_maxrss unit split (KiB on Linux, BYTES on macOS)
             rec["host_max_rss_mb"] = \
-                resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
-            try:
-                import jax
-                stats = jax.local_devices()[0].memory_stats()
-                if stats:
-                    rec["device_bytes_in_use"] = stats.get("bytes_in_use")
-            except Exception:
-                pass
+                metrics_mod.host_rss_bytes() / (1024.0 * 1024.0)
+            devs = metrics_mod.device_memory_stats()
+            if devs and devs[0]["bytes_in_use"]:
+                rec["device_bytes_in_use"] = devs[0]["bytes_in_use"]
         if cfg.collect_mean_magnitudes or cfg.collect_histograms or \
                 cfg.collect_updates:
             mm: Dict[str, float] = {}

@@ -9,3 +9,9 @@ cd "$(dirname "$0")"
 make -C native || echo "native ETL build unavailable; numpy fallbacks"
 
 python -m pytest tests/ -q "$@"
+
+# Observability smoke (docs/observability.md): a real 2-epoch fit with
+# span tracing on, then scrape GET /metrics off a live UIServer and
+# assert train_iterations_total is nonzero. Fails the CI run if the
+# registry, the endpoint, or the trace ring regresses end-to-end.
+JAX_PLATFORMS=cpu python tests/smoke_observability.py

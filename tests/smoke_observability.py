@@ -1,0 +1,89 @@
+"""Observability smoke: run a real 2-epoch fit with tracing on, scrape
+GET /metrics off a live UIServer, and assert the registry saw training.
+
+Run by runtests.sh as a separate step (no test_ prefix on purpose —
+this is an end-to-end smoke over live HTTP, not a pytest unit). Exits
+nonzero on any failed expectation.
+
+Usage: JAX_PLATFORMS=cpu python tests/smoke_observability.py
+"""
+import os
+import re
+import sys
+import urllib.request
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    from deeplearning4j_tpu import (DenseLayer, InputType,
+                                    MultiLayerNetwork,
+                                    NeuralNetConfiguration, OutputLayer,
+                                    Sgd)
+    from deeplearning4j_tpu.optimize import tracing
+    from deeplearning4j_tpu.ui.server import UIServer
+
+    tracing.enable(fence_every=4)
+    conf = (NeuralNetConfiguration.builder().seed(7).updater(Sgd(0.1))
+            .list()
+            .layer(DenseLayer(n_in=6, n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(6))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 6)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, size=64)]
+    net.fit(x, y, epochs=2, batch_size=16)
+
+    server = UIServer(port=0).start()
+    try:
+        with urllib.request.urlopen(server.url + "/metrics",
+                                    timeout=10) as r:
+            ctype = r.headers.get("Content-Type", "")
+            text = r.read().decode()
+    finally:
+        server.stop()
+        tracing.disable()
+
+    failures = []
+    if "text/plain" not in ctype:
+        failures.append(f"unexpected /metrics content type: {ctype!r}")
+    m = re.search(r"^train_iterations_total (\d+(?:\.\d+)?)$", text,
+                  re.MULTILINE)
+    if not m:
+        failures.append("train_iterations_total missing from /metrics")
+    elif float(m.group(1)) <= 0:
+        failures.append(f"train_iterations_total is {m.group(1)}, "
+                        "expected nonzero after a 2-epoch fit")
+    families = {ln.split()[2] for ln in text.splitlines()
+                if ln.startswith("# TYPE ")}
+    if len(families) < 10:
+        failures.append(f"only {len(families)} metric families exposed "
+                        f"({sorted(families)}); expected >= 10")
+    for needed in ("device_bytes_in_use", "device_peak_bytes_in_use",
+                   "xla_compilations_total", "train_epochs_total"):
+        if needed not in families:
+            failures.append(f"{needed} missing from /metrics")
+
+    spans = {e["name"] for e in tracing.export_trace_events()["traceEvents"]}
+    for needed in ("fit", "epoch", "step"):
+        if needed not in spans:
+            failures.append(f"span {needed!r} missing from trace ring")
+
+    if failures:
+        for f in failures:
+            print(f"SMOKE FAIL: {f}", file=sys.stderr)
+        return 1
+    print(f"observability smoke OK: {len(families)} metric families, "
+          f"train_iterations_total={m.group(1)}, spans={sorted(spans)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
