@@ -21,7 +21,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...data.dataset import DataSet, MultiDataSet
+from ...optimize import compile_cache as compile_cache_mod
 from ...optimize import metrics as metrics_mod
+from ...optimize import telemetry as telemetry_mod
 from ...optimize import tracing
 from ...utils import params as param_utils
 from ..conf.builders import BackpropType
@@ -33,6 +35,14 @@ from ..stepping import DeviceIterationMixin
 from ..layers.recurrent import RECURRENT_CARRY_KEYS
 
 Array = jax.Array
+
+# Training-only jit attributes, built lazily on first touch (the MLN
+# _TRAIN_JIT_ATTRS analog; inference-only graphs never pay their
+# compiles).
+_TRAIN_JIT_ATTRS = (
+    "_train_step_fn", "_train_step_raw",
+    "_multi_step_stacked_fn", "_multi_step_repeat_fn",
+)
 
 
 class _SlicingMultiIterator:
@@ -75,12 +85,21 @@ class ComputationGraph(DeviceIterationMixin):
         self.last_etl_h2d_ms: float = 0.0
         self._dtype = jnp.float32
         self._rng = None
+        self._probe_tag = f"{id(self) & 0xffff:04x}"
         self._initialized = False
         self._layer_nodes = [n for n in conf.topo_order
                              if conf.nodes[n].is_layer()]
         # Streaming/tBPTT recurrent carry, keyed by node name (the MLN
         # _rnn_carry analog; reference ComputationGraph rnn state maps).
         self._rnn_carry: Optional[Dict[str, dict]] = None
+
+    def __getattr__(self, name):
+        # Lazy training jits (see MultiLayerNetwork.__getattr__).
+        if name in _TRAIN_JIT_ATTRS and self.__dict__.get("_initialized"):
+            self._build_training_jits()
+            return self.__dict__[name]
+        raise AttributeError(
+            f"{type(self).__name__!r} object has no attribute {name!r}")
 
     # ------------------------------------------------------------------ init
     def init(self, seed: Optional[int] = None, dtype=jnp.float32
@@ -182,6 +201,35 @@ class ComputationGraph(DeviceIterationMixin):
         return total + reg, new_state
 
     def _build_jitted(self):
+        """(Re)build the inference jits and invalidate the training
+        jits (rebuilt lazily via __getattr__ — see
+        MultiLayerNetwork._build_jitted)."""
+        conf = self.conf
+        for name in _TRAIN_JIT_ATTRS:
+            self.__dict__.pop(name, None)
+        self._output_fn = compile_cache_mod.PrecompiledDispatch(
+            jax.jit(lambda params, state, inputs, fmasks:
+                    [self._walk(params, state, inputs, False, None,
+                                fmasks)[0][n]
+                     for n in conf.network_outputs]),
+            f"graph_output#{self._probe_tag}")
+        self._ff_named_fn = jax.jit(
+            lambda params, state, inputs:
+            self._walk(params, state, inputs, False, None, {})[0])
+        self._loss_fn_jit = compile_cache_mod.PrecompiledDispatch(
+            jax.jit(lambda params, state, inputs, labels, fmasks, lmasks:
+                    self._loss_pure(params, state, inputs, labels, fmasks,
+                                    lmasks, None, False)[0]),
+            f"graph_loss#{self._probe_tag}")
+
+        def rnn_step(params, state, inputs):
+            acts, new_state, _, _ = self._walk(params, state, inputs,
+                                               False, None, {})
+            return [acts[n] for n in conf.network_outputs], new_state
+
+        self._rnn_step_fn = jax.jit(rnn_step)
+
+    def _build_training_jits(self):
         layer_nodes = self._layer_nodes
         conf = self.conf
 
@@ -212,9 +260,11 @@ class ComputationGraph(DeviceIterationMixin):
             return (new_params, new_opt, new_state, iteration + 1, rng, loss)
 
         # Donate params/opt/state (see MultiLayerNetwork._build_jitted).
-        self._train_step_fn = jax.jit(train_step, donate_argnums=(0, 1, 2))
+        self._train_step_fn = compile_cache_mod.PrecompiledDispatch(
+            jax.jit(train_step, donate_argnums=(0, 1, 2)),
+            f"graph_train_step#{self._probe_tag}")
         metrics_mod.register_jit_probe(
-            f"graph_train_step#{id(self) & 0xffff:04x}",
+            f"graph_train_step#{self._probe_tag}",
             self._train_step_fn)
         # Unjitted step for wrappers that trace under their own context
         # (SequenceParallelWrapper) without polluting this cache.
@@ -249,27 +299,110 @@ class ComputationGraph(DeviceIterationMixin):
 
         self._multi_step_stacked_fn = jax.jit(
             multi_step_stacked, donate_argnums=(0, 1, 2))
-        self._multi_step_repeat_fn = jax.jit(
-            multi_step_repeat, donate_argnums=(0, 1, 2),
+        self._multi_step_repeat_fn = compile_cache_mod.PrecompiledDispatch(
+            jax.jit(multi_step_repeat, donate_argnums=(0, 1, 2),
+                    static_argnums=(9,)),
+            f"graph_multi_step_repeat#{self._probe_tag}",
             static_argnums=(9,))
-        self._output_fn = jax.jit(
-            lambda params, state, inputs, fmasks:
-            [self._walk(params, state, inputs, False, None, fmasks)[0][n]
-             for n in conf.network_outputs])
-        self._ff_named_fn = jax.jit(
-            lambda params, state, inputs:
-            self._walk(params, state, inputs, False, None, {})[0])
-        self._loss_fn_jit = jax.jit(
-            lambda params, state, inputs, labels, fmasks, lmasks:
-            self._loss_pure(params, state, inputs, labels, fmasks, lmasks,
-                            None, False)[0])
 
-        def rnn_step(params, state, inputs):
-            acts, new_state, _, _ = self._walk(params, state, inputs,
-                                               False, None, {})
-            return [acts[n] for n in conf.network_outputs], new_state
+    # ---------------------------------------------------------- precompile
+    def _input_structs(self, batch_size: int,
+                       time_steps: Optional[int] = None) -> Dict[str, Any]:
+        """Abstract input dict inferred from conf.input_types (one per
+        network input, the set_input_types contract)."""
+        from ..conf.inputs import (ConvolutionalFlatType, ConvolutionalType,
+                                   FeedForwardType, RecurrentType)
+        conf = self.conf
+        if not conf.input_types or \
+                len(conf.input_types) != len(conf.network_inputs):
+            raise ValueError(
+                "precompile() needs set_input_types(...) on the graph "
+                "builder (one InputType per network input)")
+        b = int(batch_size)
+        structs = {}
+        for name, it in zip(conf.network_inputs, conf.input_types):
+            if isinstance(it, ConvolutionalType):
+                shape = (b, it.height, it.width, it.channels)
+            elif isinstance(it, ConvolutionalFlatType):
+                shape = (b, it.flat_size)
+            elif isinstance(it, RecurrentType):
+                t = time_steps or it.timeseries_length
+                if not t:
+                    raise ValueError(
+                        "precompile() on a recurrent graph needs "
+                        "time_steps= (or RecurrentType with "
+                        "timeseries_length)")
+                shape = (b, int(t), it.size)
+            elif isinstance(it, FeedForwardType):
+                shape = (b, it.size)
+            else:
+                raise ValueError(
+                    f"precompile() cannot size input {name!r} from "
+                    f"{type(it).__name__}")
+            structs[name] = jax.ShapeDtypeStruct(shape, self._dtype)
+        return structs
 
-        self._rnn_step_fn = jax.jit(rnn_step)
+    def precompile(self, batch_size: int, *,
+                   time_steps: Optional[int] = None,
+                   repeat_steps: Optional[int] = None, train: bool = True,
+                   inference: bool = True) -> "ComputationGraph":
+        """AOT-compile the train/output/loss steps for one batch
+        signature (the MultiLayerNetwork.precompile analog; see
+        docs/perf_compile_cache.md). Covers the maskless signature and
+        the fit loop's synthesized ones-mask signature; user-masked
+        batches fall through to normal jit dispatch."""
+        self._check_init()
+        if train and self.conf.backprop_type == BackpropType.TRUNCATED_BPTT:
+            raise NotImplementedError(
+                "precompile() does not support truncated-BPTT graphs; "
+                "precompile(train=False) still covers inference")
+        inputs_s = self._input_structs(batch_size, time_steps)
+        params_s = compile_cache_mod.abstract_like(self.params_tree)
+        state_s = compile_cache_mod.abstract_like(self.state_tree)
+        outs_s = jax.eval_shape(
+            lambda p, s, i: [self._walk(p, s, i, False, None, {})[0][n]
+                             for n in self.conf.network_outputs],
+            params_s, state_s, inputs_s)
+        labels_s = {name: jax.ShapeDtypeStruct(o.shape, o.dtype)
+                    for name, o in zip(self.conf.network_outputs, outs_s)}
+        if inference:
+            self._output_fn.precompile(params_s, state_s, inputs_s, {})
+            self._loss_fn_jit.precompile(params_s, state_s, inputs_s,
+                                         labels_s, {}, {})
+        if not train:
+            return self
+        opt_s = compile_cache_mod.abstract_like(self.opt_state)
+        it_s = jax.ShapeDtypeStruct((), jnp.int32)
+        rng_s = jax.ShapeDtypeStruct(tuple(self._rng.shape),
+                                     self._rng.dtype)
+        # Two signatures: maskless, and the per-output ones-(b,1)
+        # labels masks the default fit loop's pad-to-bucket iterator
+        # synthesizes on every batch (data/iterators.py) — the _pack
+        # contract turns those into this dict shape.
+        lm_s = {name: jax.ShapeDtypeStruct((int(batch_size), 1),
+                                           jnp.float32)
+                for name in self.conf.network_outputs}
+        for lmasks in ({}, lm_s):
+            self._train_step_fn.precompile(
+                params_s, opt_s, state_s, it_s, rng_s, inputs_s,
+                labels_s, {}, lmasks)
+        if repeat_steps:
+            self._multi_step_repeat_fn.precompile(
+                params_s, opt_s, state_s, it_s, rng_s, inputs_s,
+                labels_s, {}, {}, int(repeat_steps))
+        return self
+
+    def warmup(self, batch_size: int = 1, *,
+               time_steps: Optional[int] = None) -> "ComputationGraph":
+        """Serving cold-start eliminator (see MultiLayerNetwork.warmup):
+        AOT-compile inference and push one concrete zero batch through
+        outputs()."""
+        self._check_init()
+        self.precompile(batch_size, time_steps=time_steps, train=False)
+        inputs_s = self._input_structs(batch_size, time_steps)
+        self.outputs(*[jnp.zeros(s.shape, s.dtype)
+                       for s in inputs_s.values()])
+        return self
 
     # ----------------------------------------------------------------- data
     def _coerce(self, data, labels=None) -> MultiDataSet:
@@ -639,8 +772,18 @@ class ComputationGraph(DeviceIterationMixin):
         """Invoke the jitted step and commit results + listeners (shared by
         the single-device path and ParallelWrapper's sharded path)."""
         import contextlib
+        telemetry_mod.note_step_signature(
+            f"graph_train_step#{self._probe_tag}",
+            telemetry_mod.shape_signature(
+                *inputs.values(), *labels.values(),
+                *fmasks.values(), *lmasks.values()))
+        step = self._train_step_fn
+        if mesh is not None:
+            # Mesh-sharded inputs bypass the AOT executables (lowered
+            # for single-device placement) — see MultiLayerNetwork.
+            step = getattr(step, "jit", step)
         with (mesh if mesh is not None else contextlib.nullcontext()):
-            out = self._train_step_fn(
+            out = step(
                 self.params_tree, self.opt_state, self._merged_state(),
                 self._iteration_device(mesh), self._rng,
                 inputs, labels, fmasks, lmasks)
@@ -665,6 +808,10 @@ class ComputationGraph(DeviceIterationMixin):
             raise ValueError(f"Graph has {len(conf.network_inputs)} inputs, "
                              f"got {len(features)}")
         inputs, fmasks = self._pack_inputs(features, features_masks)
+        telemetry_mod.note_step_signature(
+            f"graph_output#{self._probe_tag}",
+            telemetry_mod.shape_signature(*inputs.values(),
+                                          *fmasks.values()))
         outs = self._output_fn(self.params_tree, self.state_tree, inputs,
                                fmasks)
         return [np.asarray(o) for o in outs]

@@ -34,7 +34,9 @@ import numpy as np
 from ..data.dataset import DataSet
 from ..data.iterators import (AsyncDataSetIterator, DataSetIterator,
                               as_iterator)
+from ..optimize import compile_cache as compile_cache_mod
 from ..optimize import metrics as metrics_mod
+from ..optimize import telemetry as telemetry_mod
 from ..optimize import tracing
 from ..utils import params as param_utils
 from .conf.builders import BackpropType, MultiLayerConfiguration
@@ -46,6 +48,15 @@ from .layers.recurrent import RECURRENT_CARRY_KEYS
 Array = jax.Array
 
 log = logging.getLogger(__name__)
+
+# Training-only jit attributes, built lazily on first touch (the
+# ParallelInference serving path never trains, so it must never pay
+# these compiles — the compile-cost control plane's "lazy" leg).
+_TRAIN_JIT_ATTRS = (
+    "_train_step_fn", "_train_step_raw",
+    "_multi_step_stacked_fn", "_multi_step_repeat_fn",
+    "_multi_step_repeat_tbptt_fn", "_multi_step_stacked_tbptt_fn",
+)
 
 
 def _regularization_score(layers, params) -> Array:
@@ -86,10 +97,22 @@ class MultiLayerNetwork(DeviceIterationMixin):
         self.last_etl_h2d_ms: float = 0.0
         self._dtype = jnp.float32
         self._rng: Optional[Array] = None
-        self._train_step_fn = None
+        # Training jits are NOT listed here: they are lazy attributes
+        # (see __getattr__) so inference-only nets skip their compiles.
         self._output_fn = None
         self._loss_fn_jit = None
+        self._probe_tag = f"{id(self) & 0xffff:04x}"
         self._initialized = False
+
+    def __getattr__(self, name):
+        # Lazy training jits: first touch of any train-path jit builds
+        # them all (they share one traced train_step closure). Guarded
+        # on _initialized so pre-init access still raises cleanly.
+        if name in _TRAIN_JIT_ATTRS and self.__dict__.get("_initialized"):
+            self._build_training_jits()
+            return self.__dict__[name]
+        raise AttributeError(
+            f"{type(self).__name__!r} object has no attribute {name!r}")
 
     # ------------------------------------------------------------------ init
     def init(self, seed: Optional[int] = None, dtype=jnp.float32) -> "MultiLayerNetwork":
@@ -166,6 +189,29 @@ class MultiLayerNetwork(DeviceIterationMixin):
         return loss + reg, tuple(new_states)
 
     def _build_jitted(self):
+        """(Re)build the inference jits and invalidate the training
+        jits. Training jits rebuild lazily on first touch
+        (__getattr__ → _build_training_jits) so inference-only nets —
+        the ParallelInference serving path — never pay their compiles,
+        and a post-init retrace (bench's Pallas toggle) stays cheap
+        until training actually resumes."""
+        for name in _TRAIN_JIT_ATTRS:
+            self.__dict__.pop(name, None)
+        self._output_fn = compile_cache_mod.PrecompiledDispatch(
+            jax.jit(lambda params, state, x, fmask:
+                    self._forward_pure(params, state, x, False, None,
+                                       fmask)[0]),
+            f"mln_output#{self._probe_tag}")
+        self._rnn_step_fn = jax.jit(
+            lambda params, state, x:
+            self._forward_pure(params, state, x, False, None, None)[:2])
+        self._loss_fn_jit = compile_cache_mod.PrecompiledDispatch(
+            jax.jit(lambda params, state, x, y, fmask, lmask:
+                    self._loss_pure(params, state, x, y, fmask, lmask,
+                                    None, False)[0]),
+            f"mln_loss#{self._probe_tag}")
+
+    def _build_training_jits(self):
         layers = self.layers
 
         def train_step(params, opt_state, state, iteration, rng, x, y, fmask, lmask):
@@ -196,9 +242,11 @@ class MultiLayerNetwork(DeviceIterationMixin):
         # crossing network boundaries (clone, transfer learning) are
         # deep-copied at those seams so donation can never kill a shared
         # buffer.
-        self._train_step_fn = jax.jit(train_step, donate_argnums=(0, 1, 2))
+        self._train_step_fn = compile_cache_mod.PrecompiledDispatch(
+            jax.jit(train_step, donate_argnums=(0, 1, 2)),
+            f"mln_train_step#{self._probe_tag}")
         metrics_mod.register_jit_probe(
-            f"mln_train_step#{id(self) & 0xffff:04x}", self._train_step_fn)
+            f"mln_train_step#{self._probe_tag}", self._train_step_fn)
         # Unjitted step: wrappers that must trace under their OWN context
         # (SequenceParallelWrapper's ring-attention routing) re-jit this
         # so the net's cached trace is never polluted.
@@ -228,8 +276,10 @@ class MultiLayerNetwork(DeviceIterationMixin):
 
         self._multi_step_stacked_fn = jax.jit(
             multi_step_stacked, donate_argnums=(0, 1, 2))
-        self._multi_step_repeat_fn = jax.jit(
-            multi_step_repeat, donate_argnums=(0, 1, 2),
+        self._multi_step_repeat_fn = compile_cache_mod.PrecompiledDispatch(
+            jax.jit(multi_step_repeat, donate_argnums=(0, 1, 2),
+                    static_argnums=(9,)),
+            f"mln_multi_step_repeat#{self._probe_tag}",
             static_argnums=(9,))
 
         def _tbptt_pass(p, o, s, it, r, x, y, fmask, lmask):
@@ -297,15 +347,131 @@ class MultiLayerNetwork(DeviceIterationMixin):
             static_argnums=(9,))
         self._multi_step_stacked_tbptt_fn = jax.jit(
             multi_step_stacked_tbptt, donate_argnums=(0, 1, 2))
-        self._output_fn = jax.jit(
-            lambda params, state, x, fmask:
-            self._forward_pure(params, state, x, False, None, fmask)[0])
-        self._rnn_step_fn = jax.jit(
-            lambda params, state, x:
-            self._forward_pure(params, state, x, False, None, None)[:2])
-        self._loss_fn_jit = jax.jit(
-            lambda params, state, x, y, fmask, lmask:
-            self._loss_pure(params, state, x, y, fmask, lmask, None, False)[0])
+
+    # ---------------------------------------------------------- precompile
+    def _feature_struct(self, batch_size: int,
+                        time_steps: Optional[int] = None):
+        """Abstract feature batch inferred from conf.input_type (or the
+        first layer's n_in when no input type was declared)."""
+        from .conf.inputs import (ConvolutionalFlatType, ConvolutionalType,
+                                  FeedForwardType, RecurrentType)
+        b = int(batch_size)
+        it = getattr(self.conf, "input_type", None)
+        if isinstance(it, ConvolutionalType):
+            shape = (b, it.height, it.width, it.channels)
+        elif isinstance(it, ConvolutionalFlatType):
+            shape = (b, it.flat_size)
+        elif isinstance(it, RecurrentType):
+            t = time_steps or it.timeseries_length
+            if not t:
+                raise ValueError(
+                    "precompile() on a recurrent net needs time_steps= "
+                    "(or a RecurrentType with timeseries_length)")
+            shape = (b, int(t), it.size)
+        elif isinstance(it, FeedForwardType):
+            shape = (b, it.size)
+        else:
+            n_in = getattr(self.layers[0], "n_in", None)
+            if not n_in:
+                raise ValueError(
+                    "precompile() cannot infer the input shape: declare "
+                    "an input type on the configuration")
+            if getattr(self.layers[0], "input_kind", lambda: "ff")() \
+                    == "rnn":
+                if not time_steps:
+                    raise ValueError(
+                        "precompile() on a recurrent net needs "
+                        "time_steps=")
+                shape = (b, int(time_steps), int(n_in))
+            else:
+                shape = (b, int(n_in))
+        return jax.ShapeDtypeStruct(shape, self._dtype)
+
+    def precompile(self, batch_size: int, *, time_steps: Optional[int] = None,
+                   repeat_steps: Optional[int] = None, train: bool = True,
+                   inference: bool = True) -> "MultiLayerNetwork":
+        """AOT-compile the train/output/loss steps for one batch
+        signature ahead of the first batch (reference has no analog —
+        DL4J compiles nothing; on XLA this moves the multi-second
+        compile off the serving/training critical path).
+
+        Uses `jit.lower(ShapeDtypeStruct...).compile()` and stores the
+        executables on the PrecompiledDispatch wrappers, so the later
+        `fit`/`output` calls with matching shapes run with ZERO
+        additional XLA compilations (`xla_compilations_total` stays
+        flat). For truncated-BPTT nets every distinct window length of
+        the schedule is precompiled. `repeat_steps` additionally
+        precompiles the fused `fit_batch_repeated(steps=repeat_steps)`
+        dispatch."""
+        self._check_init()
+        x_s = self._feature_struct(batch_size, time_steps)
+        params_s = compile_cache_mod.abstract_like(self.params_tree)
+        state_s = compile_cache_mod.abstract_like(self.state_tree)
+        y_s = jax.eval_shape(
+            lambda p, s, x: self._forward_pure(p, s, x, False, None,
+                                               None)[0],
+            params_s, state_s, x_s)
+        y_s = jax.ShapeDtypeStruct(y_s.shape, y_s.dtype)
+        if inference:
+            self._output_fn.precompile(params_s, state_s, x_s, None)
+            self._loss_fn_jit.precompile(params_s, state_s, x_s, y_s,
+                                         None, None)
+        if not train:
+            return self
+        opt_s = compile_cache_mod.abstract_like(self.opt_state)
+        it_s = jax.ShapeDtypeStruct((), jnp.int32)
+        rng_s = jax.ShapeDtypeStruct(tuple(self._rng.shape),
+                                     self._rng.dtype)
+        tbptt = (self.conf.backprop_type == BackpropType.TRUNCATED_BPTT
+                 and len(x_s.shape) == 3 and len(y_s.shape) == 3)
+        if tbptt:
+            # One executable per distinct window length of the schedule,
+            # against the carry-merged state (what _fit_tbptt passes).
+            b = x_s.shape[0]
+            carry = tuple(
+                layer.seed_recurrent_state(b, self._dtype)
+                if layer.is_recurrent() else {} for layer in self.layers)
+            merged_s = tuple(
+                {**st, **compile_cache_mod.abstract_like(c)}
+                for st, c in zip(state_s, carry))
+            T, L = x_s.shape[1], self.conf.tbptt_fwd_length
+            for w in sorted({min(L, T)} | {T % L} - {0}):
+                self._train_step_fn.precompile(
+                    params_s, opt_s, merged_s, it_s, rng_s,
+                    jax.ShapeDtypeStruct((b, w, x_s.shape[2]),
+                                         x_s.dtype),
+                    jax.ShapeDtypeStruct((b, w, y_s.shape[2]),
+                                         y_s.dtype),
+                    None, None)
+        else:
+            # Two signatures: maskless (direct _do_step / bench), and
+            # the ones-(b,1) labels mask the default fit loop's
+            # pad-to-bucket iterator synthesizes on EVERY batch (see
+            # data/iterators.py: uniform mask structure across the
+            # epoch) — without the latter, a plain fit() after
+            # precompile() would still pay one compile.
+            lm_s = jax.ShapeDtypeStruct((x_s.shape[0], 1), jnp.float32)
+            for lmask in (None, lm_s):
+                self._train_step_fn.precompile(
+                    params_s, opt_s, state_s, it_s, rng_s, x_s, y_s,
+                    None, lmask)
+            if repeat_steps:
+                self._multi_step_repeat_fn.precompile(
+                    params_s, opt_s, state_s, it_s, rng_s, x_s, y_s,
+                    None, None, int(repeat_steps))
+        return self
+
+    def warmup(self, batch_size: int = 1, *,
+               time_steps: Optional[int] = None) -> "MultiLayerNetwork":
+        """Serving cold-start eliminator: AOT-compile the inference path
+        for `batch_size` and push one concrete zero batch through
+        `output()` so the first real request pays neither compile nor
+        first-dispatch cost."""
+        self._check_init()
+        self.precompile(batch_size, time_steps=time_steps, train=False)
+        x_s = self._feature_struct(batch_size, time_steps)
+        self.output(jnp.zeros(x_s.shape, x_s.dtype))
+        return self
 
     # ------------------------------------------------------------------- fit
     def fit(self, data, labels=None, *, epochs: int = 1, batch_size: int = 32,
@@ -683,8 +849,17 @@ class MultiLayerNetwork(DeviceIterationMixin):
         """Invoke the jitted step and commit results + listeners. Shared by
         the single-device path and ParallelWrapper's sharded path."""
         import contextlib
+        telemetry_mod.note_step_signature(
+            f"mln_train_step#{self._probe_tag}",
+            telemetry_mod.shape_signature(x, y, fmask, lmask))
+        step = self._train_step_fn
+        if mesh is not None:
+            # Mesh-sharded inputs must not hit an AOT executable lowered
+            # for single-device placement — take the jit path, which
+            # reshards freely.
+            step = getattr(step, "jit", step)
         with (mesh if mesh is not None else contextlib.nullcontext()):
-            out = self._train_step_fn(
+            out = step(
                 self.params_tree, self.opt_state, self._merged_state(),
                 self._iteration_device(mesh), self._rng,
                 x, y, fmask, lmask)
@@ -723,9 +898,12 @@ class MultiLayerNetwork(DeviceIterationMixin):
     def output(self, x, train: bool = False, features_mask=None) -> np.ndarray:
         """Forward pass, inference mode (reference output():1664)."""
         self._check_init()
-        out = self._output_fn(self.params_tree, self.state_tree,
-                              jnp.asarray(x), None if features_mask is None
-                              else jnp.asarray(features_mask))
+        xa = jnp.asarray(x)
+        fm = None if features_mask is None else jnp.asarray(features_mask)
+        telemetry_mod.note_step_signature(
+            f"mln_output#{self._probe_tag}",
+            telemetry_mod.shape_signature(xa, fm))
+        out = self._output_fn(self.params_tree, self.state_tree, xa, fm)
         return np.asarray(out)
 
     def feed_forward(self, x, train: bool = False) -> List[np.ndarray]:

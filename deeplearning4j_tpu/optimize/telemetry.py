@@ -123,3 +123,94 @@ def jit_cache_size(fn) -> int:
         return int(probe())
     except Exception:
         return -1
+
+
+# ---------------------------------------------------------------------------
+# Recompile-churn guard
+#
+# jit_cache_size says HOW MANY shapes a step compiled for; it cannot say
+# the fit loop keeps feeding new ones. This guard records the distinct
+# shape signatures each logical step has seen and goes loud — one
+# warning plus a labeled counter — when a step crosses the threshold:
+# the canonical symptom is a data pipeline emitting ragged batches
+# (every epoch tail a fresh compile) or unbucketed variable-length
+# sequences. bench.py surfaces the offenders in its JSON.
+# ---------------------------------------------------------------------------
+ENV_CHURN_THRESHOLD = "DL4JTPU_RECOMPILE_CHURN_THRESHOLD"
+DEFAULT_CHURN_THRESHOLD = 5
+
+_churn_lock = threading.Lock()
+_step_signatures: dict = {}   # label -> set of signatures
+_churn_warned: set = set()    # labels already warned (one-shot)
+
+
+def churn_threshold() -> int:
+    import os
+    try:
+        return int(os.environ.get(ENV_CHURN_THRESHOLD,
+                                  DEFAULT_CHURN_THRESHOLD))
+    except ValueError:
+        return DEFAULT_CHURN_THRESHOLD
+
+
+def shape_signature(*args) -> tuple:
+    """Cheap hashable signature of a call's data arguments: per-arg
+    (shape, dtype) with None passing through. Metadata only — never
+    forces a device sync."""
+    sig = []
+    for a in args:
+        if a is None:
+            sig.append(None)
+        else:
+            sig.append((tuple(getattr(a, "shape", ())),
+                        str(getattr(a, "dtype", ""))))
+    return tuple(sig)
+
+
+def note_step_signature(label: str, sig: tuple) -> int:
+    """Record one call signature for a logical step; returns the number
+    of distinct signatures seen. Crossing the threshold fires ONE loud
+    warning per label and bumps `recompile_churn_total{fn=label}` for
+    every new signature past it."""
+    with _churn_lock:
+        seen = _step_signatures.setdefault(label, set())
+        if sig in seen:
+            return len(seen)
+        seen.add(sig)
+        n = len(seen)
+        over = n > churn_threshold()
+        warn = over and label not in _churn_warned
+        if warn:
+            _churn_warned.add(label)
+    if over:
+        from .metrics import registry
+        registry().counter(
+            "recompile_churn_total",
+            "Distinct call signatures past the churn threshold — each "
+            "one was a recompile of an already-hot step"
+            ).labels(fn=label).inc()
+    if warn:
+        log.warning(
+            "RECOMPILE CHURN: %s has now been called with %d distinct "
+            "shape signatures (threshold %d) — every new signature "
+            "recompiles. Bucket or pad your batches "
+            "(pad_to_bucket=True, docs/perf_compile_cache.md)",
+            label, n, churn_threshold())
+    return n
+
+
+def churn_offenders(top: int = 5):
+    """Worst logical steps by distinct-signature count, for bench/debug
+    output: [(label, n_signatures), ...] sorted descending."""
+    with _churn_lock:
+        items = [(lbl, len(sigs)) for lbl, sigs in _step_signatures.items()]
+    items.sort(key=lambda kv: (-kv[1], kv[0]))
+    return items[:max(0, int(top))]
+
+
+def reset_churn() -> None:
+    """Forget recorded signatures and re-arm the one-shot warnings
+    (test isolation)."""
+    with _churn_lock:
+        _step_signatures.clear()
+        _churn_warned.clear()

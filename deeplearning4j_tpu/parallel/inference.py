@@ -89,6 +89,26 @@ class ParallelInference:
     def builder(model) -> "ParallelInferenceBuilder":
         return ParallelInferenceBuilder(model)
 
+    # ----------------------------------------------------------------- warmup
+    def warmup(self, *, max_bucket: Optional[int] = None,
+               time_steps: Optional[int] = None) -> "ParallelInference":
+        """Serving cold-start eliminator: AOT-precompile the model's
+        inference path for every power-of-two bucket this server can
+        coalesce to (1, 2, 4, ... batch_limit's bucket), so the FIRST
+        client request at any bucket pays neither trace nor XLA compile.
+        The model stays inference-only — its training jits remain
+        unbuilt (the lazy-jit contract in nn/multilayer.py).
+
+        `max_bucket` caps the sweep (default: the batch_limit bucket);
+        `time_steps` sizes recurrent inputs (MultiLayerNetwork/
+        ComputationGraph.precompile contract)."""
+        top = _next_bucket(max_bucket or self.batch_limit)
+        b = 1
+        while b <= top:
+            self.model.warmup(b, time_steps=time_steps)
+            b <<= 1
+        return self
+
     # ----------------------------------------------------------------- output
     def output(self, x) -> np.ndarray:
         """Predict for one request (any leading batch size). Thread-safe;

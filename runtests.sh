@@ -15,3 +15,9 @@ python -m pytest tests/ -q "$@"
 # assert train_iterations_total is nonzero. Fails the CI run if the
 # registry, the endpoint, or the trace ring regresses end-to-end.
 JAX_PLATFORMS=cpu python tests/smoke_observability.py
+
+# Compile-cache smoke (docs/perf_compile_cache.md): run the tiny lenet
+# bench twice against one temp persistent-cache dir and assert the
+# second process reports cache HITS (warm start from disk, no XLA
+# recompile) with both runs under the wall ceiling.
+JAX_PLATFORMS=cpu python tests/smoke_compile_cache.py
