@@ -669,7 +669,8 @@ def main():
     arg = argv[1] if len(argv) > 1 else None
 
     if once:
-        from deeplearning4j_tpu.optimize import compile_cache, telemetry
+        from deeplearning4j_tpu.optimize import (compile_cache, resilience,
+                                                 telemetry)
         from deeplearning4j_tpu.optimize.metrics import registry
         from deeplearning4j_tpu.optimize.telemetry import CompilationTracker
         # Persistent XLA cache (docs/perf_compile_cache.md): a warm dir
@@ -678,6 +679,11 @@ def main():
         # DL4JTPU_COMPILE_CACHE_DIR (the parent loop points children at
         # a shared dir).
         compile_cache.enable()
+        # Pre-register the recovery counters (rollbacks_total,
+        # retries_total, ...) so the perf trajectory records recovery
+        # activity — including its absence — in every snapshot
+        # (docs/robustness.md).
+        resilience.register_metrics()
         with CompilationTracker() as trk:
             metric, ips, unit, extra = run_once(workload, arg)
         # XLA compilations the measurement triggered: warm-up should own

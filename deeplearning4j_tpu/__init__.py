@@ -56,6 +56,9 @@ from .optimize.listeners import (CheckpointListener,
                                  EvaluativeListener, IterationListener,
                                  ParamAndGradientIterationListener,
                                  PerformanceListener, ScoreIterationListener)
-from .utils.model_serializer import restore_model, save_model
+from .optimize.resilience import (CheckpointManager, DivergenceError,
+                                  DivergenceSentinel, RetryPolicy)
+from .utils.model_serializer import (CheckpointCorruptError, restore_model,
+                                     save_model)
 
 __version__ = "0.1.0"

@@ -177,6 +177,30 @@ class AsyncDataSetIterator(DataSetIterator):
         iterator (host ETL)."""
         return ds
 
+    def _next_resilient(self, it):
+        """One base-iterator poll with ONE transparent retry on transient
+        failure (flaky storage/network-backed iterators; the ``etl.next``
+        fault point fires per attempt). A second consecutive failure
+        propagates to the consumer as usual."""
+        from ..utils import faults
+        try:
+            faults.fire("etl.next")
+            return next(it)
+        except StopIteration:
+            raise
+        except Exception as e:
+            import logging
+            from ..optimize import metrics as metrics_mod
+            metrics_mod.registry().counter(
+                "retries_total",
+                "Transient-failure retries per distributed edge"
+                ).labels(edge="etl.next").inc()
+            logging.getLogger(__name__).warning(
+                "prefetch producer: base iterator failed "
+                "(%s: %s); retrying once", type(e).__name__, e)
+            faults.fire("etl.next")
+            return next(it)
+
     def _producer(self, q: queue.Queue):
         import time
         try:
@@ -184,7 +208,7 @@ class AsyncDataSetIterator(DataSetIterator):
             while True:
                 t0 = time.perf_counter()
                 try:
-                    ds = next(it)
+                    ds = self._next_resilient(it)
                 except StopIteration:
                     break
                 host_ms = (time.perf_counter() - t0) * 1000.0

@@ -49,7 +49,9 @@ class InMemoryModelSaver(EarlyStoppingModelSaver):
 
 class LocalFileModelSaver(EarlyStoppingModelSaver):
     """Checkpoint best/latest to disk (reference LocalFile{Model,Graph}Saver
-    — one saver handles both model classes here)."""
+    — one saver handles both model classes here). Both writes are atomic
+    (save_model's tmp+fsync+rename path), so a crash mid-save never tears
+    an existing bestModel.zip/latestModel.zip."""
 
     def __init__(self, directory: str):
         self.dir = directory
@@ -66,7 +68,21 @@ class LocalFileModelSaver(EarlyStoppingModelSaver):
         save_model(model, self.latest_path)
 
     def get_best_model(self):
-        from ..utils.model_serializer import restore_model
+        """Restore bestModel.zip; if it is corrupt (e.g. pre-atomic-write
+        torn file, disk damage), fall back to latestModel.zip with a
+        warning rather than raising — a slightly-worse model beats losing
+        the early-stopping run."""
+        import logging
+        from ..utils.model_serializer import (CheckpointCorruptError,
+                                              restore_model)
         if not os.path.exists(self.best_path):
             return None
-        return restore_model(self.best_path)
+        try:
+            return restore_model(self.best_path)
+        except CheckpointCorruptError as e:
+            log = logging.getLogger(__name__)
+            if not os.path.exists(self.latest_path):
+                raise
+            log.warning("bestModel.zip is corrupt (%s); falling back to "
+                        "latestModel.zip", e)
+            return restore_model(self.latest_path)

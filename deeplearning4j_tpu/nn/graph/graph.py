@@ -14,6 +14,8 @@ DAG-shaped. Masks propagate along the walk via vertex.output_mask.
 """
 from __future__ import annotations
 
+import logging
+
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -459,7 +461,8 @@ class ComputationGraph(DeviceIterationMixin):
             async_queue_size: int = 8, steps_per_dispatch: int = 1,
             pad_to_bucket: bool = True, prefetch_to_device: bool = True,
             prefetch_depth: int = 2, prefetch_sharding=None,
-            prefetch_divisor: int = 1
+            prefetch_divisor: int = 1,
+            checkpoint=None, resume: bool = False, sentinel=None
             ) -> "ComputationGraph":
         """Train (reference fit(MultiDataSetIterator):867). Accepts a
         MultiDataSet, DataSet, (features, labels) arrays, or an iterator of
@@ -472,7 +475,10 @@ class ComputationGraph(DeviceIterationMixin):
         one compiled step serves the whole epoch
         (docs/perf_data_pipeline.md — both mirror MultiLayerNetwork.fit).
         `steps_per_dispatch > 1` groups same-shaped batches into one
-        fused lax.scan dispatch (see MultiLayerNetwork.fit)."""
+        fused lax.scan dispatch (see MultiLayerNetwork.fit).
+        `checkpoint`/`resume`/`sentinel` attach the fault-tolerance
+        control plane exactly as in MultiLayerNetwork.fit
+        (docs/robustness.md)."""
         from ...data.iterators import (AsyncMultiDataSetIterator,
                                        DevicePrefetchIterator,
                                        PadToBucketIterator)
@@ -481,6 +487,23 @@ class ComputationGraph(DeviceIterationMixin):
         if spd > 1 and step_fn is not None:
             raise ValueError("steps_per_dispatch cannot combine with a "
                              "custom step_fn")
+        if spd > 1 and (checkpoint is not None or sentinel is not None):
+            raise ValueError("checkpoint=/sentinel= need per-step hooks; "
+                             "use steps_per_dispatch=1")
+        if resume and checkpoint is None:
+            raise ValueError("resume=True requires checkpoint=a "
+                             "CheckpointManager to resume from")
+        skip_batches = 0
+        if resume:
+            rec = checkpoint.restore_into(self)
+            if rec is not None:
+                epochs = max(0, int(epochs) - int(self.epoch))
+                skip_batches = int(rec.get("batches_into_epoch", 0) or 0)
+                logging.getLogger(__name__).info(
+                    "auto-resume: restored %s (iteration %d, %d epoch(s) "
+                    "done, %d batch(es) into the next); %d epoch(s) "
+                    "remain", rec.get("file"), self.iteration, self.epoch,
+                    skip_batches, epochs)
         if spd > 1 and self.conf.backprop_type == \
                 BackpropType.TRUNCATED_BPTT:
             raise NotImplementedError(
@@ -537,6 +560,10 @@ class ComputationGraph(DeviceIterationMixin):
         try:
             for _ in range(epochs):
                 epoch_sp = tracing.begin("epoch", epoch=self.epoch)
+                # Resumed run: re-consume (and discard) the batches the
+                # restored checkpoint already covers — first epoch only.
+                to_skip, skip_batches = skip_batches, 0
+                batches_done = to_skip
                 it_epoch = iter(wrapped)
                 while True:
                     # Step span opens before the iterator poll so the
@@ -553,6 +580,10 @@ class ComputationGraph(DeviceIterationMixin):
                     except StopIteration:
                         step_sp.cancel()
                         break
+                    if to_skip > 0:
+                        to_skip -= 1
+                        step_sp.cancel()
+                        continue
                     etl_s = _time.perf_counter() - t0
                     self.last_etl_ms = etl_s * 1000.0
                     self.last_etl_host_ms = getattr(
@@ -564,6 +595,8 @@ class ComputationGraph(DeviceIterationMixin):
                         reg, self.last_etl_ms, self.last_etl_host_ms,
                         self.last_etl_h2d_ms, metrics_mod.batch_rows(mds))
                     t1 = _time.perf_counter()
+                    if sentinel is not None:
+                        sentinel.before_step(self)
                     with tracing.span("dispatch"):
                         if spd <= 1:
                             step(mds)
@@ -585,6 +618,11 @@ class ComputationGraph(DeviceIterationMixin):
                             "device_fence_wait_ms",
                             "Dispatch-queue drain at the last sampled "
                             "fence (device-compute backlog)").set(w)
+                    if sentinel is not None:
+                        sentinel.after_step(self)
+                    batches_done += 1
+                    if checkpoint is not None:
+                        checkpoint.on_batch(self, batches_done)
                     step_sp.end()
                 if group:
                     with tracing.span("dispatch", flush="epoch_tail"):
@@ -595,6 +633,8 @@ class ComputationGraph(DeviceIterationMixin):
                 for lst in self.listeners:
                     if hasattr(lst, "on_epoch_end"):
                         lst.on_epoch_end(self, self.epoch)
+                if checkpoint is not None:
+                    checkpoint.on_epoch(self)
                 epoch_sp.end()
         finally:
             fit_sp.end()

@@ -479,11 +479,23 @@ class MultiLayerNetwork(DeviceIterationMixin):
             step_fn=None, steps_per_dispatch: int = 1,
             pad_to_bucket: bool = True, prefetch_to_device: bool = True,
             prefetch_depth: int = 2, prefetch_sharding=None,
-            prefetch_divisor: int = 1
+            prefetch_divisor: int = 1,
+            checkpoint=None, resume: bool = False, sentinel=None
             ) -> "MultiLayerNetwork":
         """Train (reference fit(DataSetIterator):1019). Accepts a
         DataSetIterator, a DataSet, or (features, labels) arrays. `step_fn`
         lets ParallelWrapper reuse this loop with a sharded step.
+
+        Fault tolerance (docs/robustness.md): `checkpoint` attaches a
+        resilience.CheckpointManager (periodic atomic saves at its
+        configured cadence); with `resume=True` the newest valid
+        checkpoint is restored first and the loop fast-forwards past the
+        epochs/batches it already covers — on a deterministic,
+        unshuffled pipeline the resumed run is bitwise-identical to an
+        uninterrupted one (`epochs` counts TOTAL epochs for the run, not
+        additional ones). `sentinel` attaches a DivergenceSentinel
+        checking each step for non-finite loss/params. Both require
+        steps_per_dispatch=1 (per-step hook cadence).
 
         Input pipeline (docs/perf_data_pipeline.md): `pad_to_bucket`
         pads ragged batches (the short final batch) up to the epoch's
@@ -512,6 +524,22 @@ class MultiLayerNetwork(DeviceIterationMixin):
         if spd > 1 and step_fn is not None:
             raise ValueError("steps_per_dispatch cannot combine with a "
                              "custom step_fn")
+        if spd > 1 and (checkpoint is not None or sentinel is not None):
+            raise ValueError("checkpoint=/sentinel= need per-step hooks; "
+                             "use steps_per_dispatch=1")
+        if resume and checkpoint is None:
+            raise ValueError("resume=True requires checkpoint=a "
+                             "CheckpointManager to resume from")
+        skip_batches = 0
+        if resume:
+            rec = checkpoint.restore_into(self)
+            if rec is not None:
+                epochs = max(0, int(epochs) - int(self.epoch))
+                skip_batches = int(rec.get("batches_into_epoch", 0) or 0)
+                log.info("auto-resume: restored %s (iteration %d, %d "
+                         "epoch(s) done, %d batch(es) into the next); "
+                         "%d epoch(s) remain", rec.get("file"),
+                         self.iteration, self.epoch, skip_batches, epochs)
         it = as_iterator(data, labels, batch_size)
         if pad_to_bucket and \
                 self.conf.backprop_type != BackpropType.TRUNCATED_BPTT:
@@ -554,6 +582,10 @@ class MultiLayerNetwork(DeviceIterationMixin):
         try:
             for _ in range(epochs):
                 epoch_sp = tracing.begin("epoch", epoch=self.epoch)
+                # Resumed run: re-consume (and discard) the batches the
+                # restored checkpoint already covers — first epoch only.
+                to_skip, skip_batches = skip_batches, 0
+                batches_done = to_skip
                 it_epoch = iter(wrapped)
                 while True:
                     # The step span opens BEFORE the iterator is polled
@@ -570,6 +602,10 @@ class MultiLayerNetwork(DeviceIterationMixin):
                     except StopIteration:
                         step_sp.cancel()
                         break
+                    if to_skip > 0:
+                        to_skip -= 1
+                        step_sp.cancel()
+                        continue
                     etl_s = _time.perf_counter() - t0
                     self.last_etl_ms = etl_s * 1000.0
                     # Device-prefetched batches carry the producer-side
@@ -584,6 +620,8 @@ class MultiLayerNetwork(DeviceIterationMixin):
                         reg, self.last_etl_ms, self.last_etl_host_ms,
                         self.last_etl_h2d_ms, metrics_mod.batch_rows(ds))
                     t1 = _time.perf_counter()
+                    if sentinel is not None:
+                        sentinel.before_step(self)
                     with tracing.span("dispatch"):
                         if spd <= 1:
                             step(ds)
@@ -605,6 +643,11 @@ class MultiLayerNetwork(DeviceIterationMixin):
                             "device_fence_wait_ms",
                             "Dispatch-queue drain at the last sampled "
                             "fence (device-compute backlog)").set(w)
+                    if sentinel is not None:
+                        sentinel.after_step(self)
+                    batches_done += 1
+                    if checkpoint is not None:
+                        checkpoint.on_batch(self, batches_done)
                     step_sp.end()
                 if group:  # end of epoch: run the partial group
                     with tracing.span("dispatch", flush="epoch_tail"):
@@ -615,6 +658,8 @@ class MultiLayerNetwork(DeviceIterationMixin):
                 for lst in self.listeners:
                     if hasattr(lst, "on_epoch_end"):
                         lst.on_epoch_end(self, self.epoch)
+                if checkpoint is not None:
+                    checkpoint.on_epoch(self)
                 epoch_sp.end()
         finally:
             fit_sp.end()

@@ -282,17 +282,35 @@ class ComposableIterationListener(IterationListener):
 
 class CheckpointListener(IterationListener):
     """Periodic checkpointing (reference CheckpointListener semantics:
-    every N iterations or every N epochs, keep last K)."""
+    every N iterations or every N epochs, keep last K).
 
-    def __init__(self, directory: str, every_n_iterations: int = 0,
-                 every_n_epochs: int = 0, keep_last: int = 3):
+    Two modes: the classic `directory` mode writes bare
+    ``checkpoint_{tag}.zip`` files (now atomic via save_model's
+    tmp+rename path) with simple keep-last pruning; passing ``manager=``
+    (a resilience.CheckpointManager) instead delegates cadence, manifest,
+    checksums, and retention to the manager — the crash-safe/resumable
+    format (docs/robustness.md). With a manager, the every_n/keep_last
+    args are ignored (the manager carries its own). Note the listener
+    counts iteration_done events as "batches"; under truncated BPTT that
+    over-counts windows — resume through fit(checkpoint=) counts true
+    batches."""
+
+    def __init__(self, directory: Optional[str] = None,
+                 every_n_iterations: int = 0,
+                 every_n_epochs: int = 0, keep_last: int = 3,
+                 manager=None):
         import os
-        self.dir = directory
-        os.makedirs(directory, exist_ok=True)
+        if (directory is None) == (manager is None):
+            raise ValueError("pass exactly one of directory= or manager=")
+        self.manager = manager
+        self.dir = directory if manager is None else manager.directory
+        if manager is None:
+            os.makedirs(directory, exist_ok=True)
         self.every_n_iterations = int(every_n_iterations)
         self.every_n_epochs = int(every_n_epochs)
         self.keep_last = int(keep_last)
         self.saved: List[str] = []
+        self._batches_into_epoch = 0
 
     def _save(self, model, tag: str):
         import os
@@ -308,10 +326,18 @@ class CheckpointListener(IterationListener):
                 pass
 
     def iteration_done(self, model, iteration):
+        if self.manager is not None:
+            self._batches_into_epoch += 1
+            self.manager.on_batch(model, self._batches_into_epoch)
+            return
         if self.every_n_iterations > 0 and \
                 iteration % self.every_n_iterations == 0:
             self._save(model, f"iter_{iteration}")
 
     def on_epoch_end(self, model, epoch):
+        if self.manager is not None:
+            self._batches_into_epoch = 0
+            self.manager.on_epoch(model)
+            return
         if self.every_n_epochs > 0 and epoch % self.every_n_epochs == 0:
             self._save(model, f"epoch_{epoch}")

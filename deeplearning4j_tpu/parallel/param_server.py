@@ -40,8 +40,20 @@ from ..data.iterators import as_iterator
 from ..nn.multilayer import MultiLayerNetwork
 from ..nn.updaters import normalize_layer_gradients
 from ..optimize import metrics as metrics_mod
+from ..optimize import resilience
+from ..utils import faults
 
 log = logging.getLogger(__name__)
+
+
+def _worker_failure(errors: list) -> "RuntimeError":
+    """Aggregate EVERY collected worker error into one exception message
+    (a multi-worker failure losing all but errors[0] made the real root
+    cause — often on a different worker — invisible)."""
+    msgs = "; ".join(f"[worker error {i}] {type(e).__name__}: {e}"
+                     for i, e in enumerate(errors))
+    return RuntimeError(
+        f"parameter-server worker failed ({len(errors)} error(s)): {msgs}")
 
 
 def _layer_map(net):
@@ -142,7 +154,8 @@ class ParameterServerTrainer:
     def __init__(self, net,
                  workers: Optional[int] = None,
                  devices: Optional[List[jax.Device]] = None,
-                 max_staleness: int = 2, queue_size: int = 4):
+                 max_staleness: int = 2, queue_size: int = 4,
+                 max_worker_restarts: int = 2):
         net._check_init()
         states = (net.state_tree.values()
                   if isinstance(net.state_tree, dict) else net.state_tree)
@@ -163,6 +176,12 @@ class ParameterServerTrainer:
         self.server = ParameterServer(net, max_staleness=max_staleness)
         self.queue_size = int(queue_size)
         self.losses: List[float] = []
+        # shared respawn budget across all workers: a transiently-failing
+        # worker loop restarts in place instead of dying permanently, a
+        # systematically-failing fleet still surfaces the error
+        self.max_worker_restarts = int(max_worker_restarts)
+        self._restarts_left = self.max_worker_restarts
+        self._restart_lock = threading.Lock()
 
         # both network classes expose _loss_pure(params, state, DATA...,
         # rng, train); the worker packs DataSets into the right DATA args
@@ -188,35 +207,66 @@ class ParameterServerTrainer:
 
     def _worker(self, wid: int, q: "queue.Queue", errors: list,
                 stop: threading.Event):
+        """Respawn shell: restarts the worker loop in place on error
+        while the shared budget lasts; only then does the worker die and
+        surface its error to fit()."""
+        attempt = 0
+        while True:
+            try:
+                self._worker_loop(wid, attempt, q, stop)
+                return
+            except Exception as e:
+                with self._restart_lock:
+                    allowed = self._restarts_left > 0 and not stop.is_set()
+                    if allowed:
+                        self._restarts_left -= 1
+                if not allowed:
+                    # surfaced by fit(); a dead worker must not silently
+                    # hang the queue
+                    errors.append(e)
+                    log.exception("parameter-server worker %d died", wid)
+                    return
+                attempt += 1
+                metrics_mod.registry().counter(
+                    "worker_respawns_total",
+                    "Parameter-server worker loops respawned after an "
+                    "error").inc()
+                log.warning("parameter-server worker %d failed "
+                            "(%s: %s); respawning (restarts left: %d)",
+                            wid, type(e).__name__, e, self._restarts_left)
+
+    def _worker_loop(self, wid: int, attempt: int, q: "queue.Queue",
+                     stop: threading.Event):
         dev = self.devices[wid]
-        rng = jax.random.PRNGKey(1000 + wid)
+        # fresh key stream per (worker, incarnation) — async SGD carries
+        # no cross-respawn rng contract
+        rng = jax.random.PRNGKey(1000 + wid + 100000 * attempt)
         state = jax.device_put(self.net.state_tree, dev)
         steps = metrics_mod.registry().counter(
             "param_server_worker_steps_total",
             "Applied async-SGD steps per worker thread"
             ).labels(worker=str(wid))
-        try:
+        while not stop.is_set():
+            try:
+                item = q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            if item is None:
+                return
+            data = jax.device_put(self._pack_item(item), dev)
+            # stale-push redo loop checks stop too: an aborting fit must
+            # not leave a worker spinning pull/push forever
             while not stop.is_set():
-                try:
-                    item = q.get(timeout=0.2)
-                except queue.Empty:
-                    continue
-                if item is None:
-                    return
-                data = jax.device_put(self._pack_item(item), dev)
-                while True:
-                    version, params = self.server.pull(dev)
-                    rng, sub = jax.random.split(rng)
-                    loss, grads = self._grad_fn(params, state, sub,
-                                                *data)
-                    if self.server.push(version, grads):
-                        self.losses.append(float(loss))
-                        steps.inc()
-                        break
-                    # dropped as stale: re-pull fresh params and redo
-        except Exception as e:  # surfaced by fit(); a dead worker must
-            errors.append(e)   # not silently hang the queue
-            log.exception("parameter-server worker %d died", wid)
+                faults.fire("ps.pull")
+                version, params = self.server.pull(dev)
+                rng, sub = jax.random.split(rng)
+                loss, grads = self._grad_fn(params, state, sub, *data)
+                faults.fire("ps.push")
+                if self.server.push(version, grads):
+                    self.losses.append(float(loss))
+                    steps.inc()
+                    break
+                # dropped as stale: re-pull fresh params and redo
 
     def fit(self, data, labels=None, *, epochs: int = 1,
             batch_size: int = 32) -> "ParameterServerTrainer":
@@ -236,8 +286,7 @@ class ParameterServerTrainer:
             # queue full (nobody left to drain it)
             while True:
                 if errors:
-                    raise RuntimeError(
-                        "parameter-server worker failed") from errors[0]
+                    raise _worker_failure(errors) from errors[0]
                 try:
                     q.put(item, timeout=0.2)
                     return
@@ -259,12 +308,25 @@ class ParameterServerTrainer:
             for t in threads:      # queue before seeing their sentinel
                 t.join()
         finally:
-            stop.set()  # error path: abort workers mid-queue
+            # Orderly shutdown on BOTH paths (a mid-epoch worker error
+            # must not strand surviving daemon threads on the queue):
+            # signal abort, drain whatever the feeder left enqueued, then
+            # join everyone with a bounded wait.
+            stop.set()
+            while True:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
             for t in threads:
-                t.join()
+                t.join(timeout=10.0)
+            alive = [t.name for t in threads if t.is_alive()]
+            if alive:
+                log.warning("parameter-server shutdown: %d worker "
+                            "thread(s) still alive after join timeout: "
+                            "%s", len(alive), alive)
         if errors:
-            raise RuntimeError("parameter-server worker failed") \
-                from errors[0]
+            raise _worker_failure(errors) from errors[0]
         # commit the server's latest state back into the network
         self.net.params_tree = jax.device_put(
             self.server.params, jax.local_devices()[0])
@@ -341,9 +403,16 @@ class ParameterServerHttpNode:
 class HttpParameterServerClient:
     """Worker-side pull/push over HTTP (reference ParameterServerClient).
     `template` is a matching params pytree used to decode the wire blobs
-    (workers always hold the model, so it is free)."""
+    (workers always hold the model, so it is free).
 
-    def __init__(self, url: str, template):
+    pull/push retry transient transport failures with exponential
+    backoff + jitter under `retry` (a resilience.RetryPolicy; default
+    from the DL4JTPU_RETRY_* env knobs — docs/robustness.md). The
+    ``ps.pull``/``ps.push`` fault points fire once per ATTEMPT, so
+    injected transient faults within the budget are fully absorbed."""
+
+    def __init__(self, url: str, template,
+                 retry: Optional[resilience.RetryPolicy] = None):
         import base64
 
         from ..utils.model_serializer import (_npz_bytes_to_tree,
@@ -353,6 +422,7 @@ class HttpParameterServerClient:
         self._b64 = base64
         self._to_npz = _tree_to_npz_bytes
         self._from_npz = _npz_bytes_to_tree
+        self.retry = retry
 
     def _get(self, path):
         import json as _json
@@ -361,7 +431,11 @@ class HttpParameterServerClient:
             return _json.loads(r.read())
 
     def pull(self):
-        rec = self._get("/params")
+        def attempt():
+            faults.fire("ps.pull")
+            return self._get("/params")
+        rec = resilience.retry_call(attempt, edge="ps.pull",
+                                    policy=self.retry)
         params = self._from_npz(self._b64.b64decode(rec["blob"]),
                                 self._template)
         return int(rec["version"]), params
@@ -376,8 +450,13 @@ class HttpParameterServerClient:
         req = urllib.request.Request(
             self.url + "/push", data=body,
             headers={"Content-Type": "application/json"})
-        with urllib.request.urlopen(req, timeout=60) as r:
-            return bool(_json.loads(r.read())["applied"])
+
+        def attempt():
+            faults.fire("ps.push")
+            with urllib.request.urlopen(req, timeout=60) as r:
+                return bool(_json.loads(r.read())["applied"])
+        return resilience.retry_call(attempt, edge="ps.push",
+                                     policy=self.retry)
 
     def stats(self) -> dict:
         return self._get("/stats")
@@ -385,11 +464,15 @@ class HttpParameterServerClient:
 
 def remote_worker_fit(net, url: str, data,
                       labels=None, *, epochs: int = 1,
-                      batch_size: int = 32, seed: int = 0) -> int:
+                      batch_size: int = 32, seed: int = 0,
+                      retry: Optional[resilience.RetryPolicy] = None
+                      ) -> int:
     """One remote worker's training loop against an HTTP parameter
     server: pull -> local gradient -> push, retrying dropped (stale)
     pushes on fresh params (the ParameterServerTrainingHook loop a Spark
-    executor runs). Returns the number of applied pushes."""
+    executor runs). Transient transport failures back off and retry
+    under `retry` (default: env-configured resilience.RetryPolicy).
+    Returns the number of applied pushes."""
     net._check_init()
     states = (net.state_tree.values()
               if isinstance(net.state_tree, dict) else net.state_tree)
@@ -401,7 +484,7 @@ def remote_worker_fit(net, url: str, data,
         raise NotImplementedError(
             "remote_worker_fit drives MultiLayerNetwork; use the "
             "in-process ParameterServerTrainer for ComputationGraph")
-    client = HttpParameterServerClient(url, net.params_tree)
+    client = HttpParameterServerClient(url, net.params_tree, retry=retry)
     rng = jax.random.PRNGKey(seed)
 
     def loss_and_grads(params, state, rng_, x, y, fmask, lmask):

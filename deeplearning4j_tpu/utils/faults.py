@@ -1,0 +1,176 @@
+"""Deterministic fault-injection registry (reference: the chaos hooks DL4J's
+parameter-server tests relied on, rebuilt as a first-class module).
+
+Production code calls :func:`fire` / :func:`check` at *named injection
+points*; when nothing is armed both are near-free no-ops.  Tests (or an
+operator, via environment variables) arm a point with a plan string:
+
+    ``"fail:2"``      raise :class:`FaultInjected` on the 2nd call
+    ``"fail:1,3"``    ... on the 1st and 3rd calls
+    ``"fail:2-4"``    ... on calls 2 through 4
+    ``"fail:*"``      ... on every call
+    ``"kill:3"``      SIGKILL *this process* on the 3rd call (crash tests)
+
+Call numbers are 1-based and counted per point, so a plan is fully
+deterministic: the same program order always hits the same faults.
+
+Points used by the training stack (arbitrary names are allowed):
+
+    checkpoint.write   inside the atomic checkpoint writer, before rename
+    ps.push / ps.pull  each parameter-server transport attempt (per retry)
+    etl.next           each base-iterator poll in the async producer
+    step.nonfinite     per-step divergence flag (checked, never raised)
+
+Environment arming: ``DL4JTPU_FAULT_<POINT>`` with dots mapped to
+underscores, e.g. ``DL4JTPU_FAULT_CHECKPOINT_WRITE="kill:3"`` — this is
+how subprocess crash tests arm the child without touching its code.
+
+Stdlib-only on purpose: everything in the package may import this.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import threading
+from contextlib import contextmanager
+from typing import Dict, Optional, Set
+
+
+class FaultInjected(RuntimeError):
+    """Raised at an armed injection point.
+
+    Marked ``transient`` so retry helpers treat it like a flaky-transport
+    error rather than a programming bug.
+    """
+
+    transient = True
+
+
+class _Plan:
+    __slots__ = ("action", "calls", "always", "count", "fired")
+
+    def __init__(self, action: str, calls: Set[int], always: bool):
+        self.action = action      # "fail" | "kill"
+        self.calls = calls        # 1-based call numbers covered
+        self.always = always
+        self.count = 0            # calls seen at this point
+        self.fired = 0            # calls that actually faulted
+
+
+def _parse(spec: str) -> _Plan:
+    action, _, arg = spec.strip().partition(":")
+    if action not in ("fail", "kill"):
+        raise ValueError(f"unknown fault action {action!r} in spec {spec!r} "
+                         "(expected 'fail:...' or 'kill:...')")
+    arg = arg.strip()
+    if arg in ("", "*"):
+        return _Plan(action, set(), always=True)
+    calls: Set[int] = set()
+    for part in arg.split(","):
+        lo, dash, hi = part.strip().partition("-")
+        try:
+            if dash:
+                calls.update(range(int(lo), int(hi) + 1))
+            else:
+                calls.add(int(lo))
+        except ValueError:
+            raise ValueError(f"bad call selector {part!r} in fault spec {spec!r}")
+    if not calls or min(calls) < 1:
+        raise ValueError(f"fault spec {spec!r} must select 1-based call numbers")
+    return _Plan(action, calls, always=False)
+
+
+_lock = threading.Lock()
+_plans: Dict[str, _Plan] = {}
+_env_checked: Set[str] = set()          # points whose env var was consulted
+
+
+def _env_var(point: str) -> str:
+    return "DL4JTPU_FAULT_" + point.upper().replace(".", "_").replace("-", "_")
+
+
+def inject(point: str, spec: str) -> None:
+    """Arm `point` with a plan (replacing any existing plan and counters)."""
+    plan = _parse(spec)
+    with _lock:
+        _plans[point] = plan
+        _env_checked.add(point)         # explicit plan wins over env
+
+
+def clear(point: Optional[str] = None) -> None:
+    """Disarm one point (or all); cleared points do not re-arm from env."""
+    with _lock:
+        if point is None:
+            _env_checked.update(_plans)
+            _plans.clear()
+        else:
+            _plans.pop(point, None)
+            _env_checked.add(point)
+
+
+def reset() -> None:
+    """Full reset, including env re-arming — test fixtures only."""
+    with _lock:
+        _plans.clear()
+        _env_checked.clear()
+
+
+def _advance(point: str) -> Optional[str]:
+    with _lock:
+        plan = _plans.get(point)
+        if plan is None:
+            if point in _env_checked:
+                return None
+            _env_checked.add(point)
+            spec = os.environ.get(_env_var(point))
+            if not spec:
+                return None
+            plan = _plans[point] = _parse(spec)
+        plan.count += 1
+        if plan.always or plan.count in plan.calls:
+            plan.fired += 1
+            return plan.action
+        return None
+
+
+def fire(point: str) -> None:
+    """Injection hook for raising points.
+
+    No-op unless an armed plan covers this call; then raises
+    :class:`FaultInjected` (``fail``) or SIGKILLs the process (``kill`` —
+    deliberately unmaskable, for torn-write crash tests).
+    """
+    action = _advance(point)
+    if action is None:
+        return
+    if action == "kill":
+        os.kill(os.getpid(), signal.SIGKILL)
+    raise FaultInjected(f"injected fault at {point!r} (call #{call_count(point)})")
+
+
+def check(point: str) -> bool:
+    """Non-raising variant for flag-style points (e.g. ``step.nonfinite``):
+    returns True when the plan covers this call."""
+    return _advance(point) is not None
+
+
+def call_count(point: str) -> int:
+    with _lock:
+        plan = _plans.get(point)
+        return plan.count if plan else 0
+
+
+def fired_count(point: str) -> int:
+    with _lock:
+        plan = _plans.get(point)
+        return plan.fired if plan else 0
+
+
+@contextmanager
+def injected(point: str, spec: str):
+    """Scoped arming for tests: arms on entry, disarms on exit."""
+    inject(point, spec)
+    try:
+        yield
+    finally:
+        clear(point)

@@ -16,16 +16,29 @@ from __future__ import annotations
 
 import io
 import json
+import os
 import zipfile
+import zlib
 from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import serde
+from . import faults, serde
 
 FORMAT_VERSION = 1
+
+
+class CheckpointCorruptError(Exception):
+    """The checkpoint archive is unreadable: truncated, missing required
+    entries, CRC-failed, or carrying an unsupported format_version.
+
+    Distinct from :class:`ValueError` (which restore raises for a *valid*
+    archive whose arrays don't match the model — a config mismatch, not
+    corruption), so callers can skip torn files and fall back to an older
+    checkpoint without masking real bugs.
+    """
 
 CONFIG_ENTRY = "configuration.json"
 META_ENTRY = "metadata.json"
@@ -81,9 +94,25 @@ def _npz_bytes_to_tree(data: bytes, template):
                               for a, b in zip(leaves, loaded)])
 
 
+# entries every readable checkpoint must carry (RNG/updater/normalizer
+# are conditional; validation for those is presence-gated on metadata)
+REQUIRED_ENTRIES = (META_ENTRY, CONFIG_ENTRY, PARAMS_ENTRY, STATE_ENTRY)
+
+# read failures on individual ZIP members (CRC mismatch surfaces as
+# BadZipFile from zipfile, deflate damage as zlib.error, short reads as
+# EOFError/struct noise wrapped in these)
+_READ_ERRORS = (zipfile.BadZipFile, zlib.error, EOFError, KeyError, OSError)
+
+
 def save_model(model, path: str, save_updater: bool = True,
                normalizer=None) -> None:
-    """Write a checkpoint ZIP (reference ModelSerializer.writeModel:39)."""
+    """Write a checkpoint ZIP (reference ModelSerializer.writeModel:39).
+
+    Atomic: the archive is built in a same-directory temp file, fsynced,
+    then `os.replace`d over `path` — a crash mid-write (exercised via the
+    ``checkpoint.write`` fault point) leaves either the previous complete
+    checkpoint or no file, never a torn archive at the final path.
+    """
     from ..nn.graph.graph import ComputationGraph
     from ..nn.multilayer import MultiLayerNetwork
 
@@ -103,34 +132,119 @@ def save_model(model, path: str, save_updater: bool = True,
         "epoch": int(model.epoch),
         "has_updater": bool(save_updater),
     }
-    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
-        zf.writestr(CONFIG_ENTRY, model.conf.to_json())
-        zf.writestr(META_ENTRY, json.dumps(meta))
-        zf.writestr(PARAMS_ENTRY, _tree_to_npz_bytes(model.params_tree))
-        zf.writestr(STATE_ENTRY, _tree_to_npz_bytes(model.state_tree))
-        if save_updater:
-            zf.writestr(UPDATER_ENTRY, _tree_to_npz_bytes(model.opt_state))
-        if model._rng is not None:
-            # the dropout key stream position: without it a resumed run's
-            # post-resume dropout masks diverge from an uninterrupted run
-            zf.writestr(RNG_ENTRY,
-                        _tree_to_npz_bytes(jnp.asarray(model._rng)))
-        if normalizer is not None:
-            zf.writestr(NORMALIZER_ENTRY, serde.to_json(normalizer))
+    path = os.fspath(path)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            with zipfile.ZipFile(f, "w", zipfile.ZIP_DEFLATED) as zf:
+                zf.writestr(CONFIG_ENTRY, model.conf.to_json())
+                zf.writestr(META_ENTRY, json.dumps(meta))
+                zf.writestr(PARAMS_ENTRY, _tree_to_npz_bytes(model.params_tree))
+                # the bulk of the bytes are on disk but the central
+                # directory is not: a kill here leaves a torn temp file,
+                # which atomicity must keep away from the final path
+                faults.fire("checkpoint.write")
+                zf.writestr(STATE_ENTRY, _tree_to_npz_bytes(model.state_tree))
+                if save_updater:
+                    zf.writestr(UPDATER_ENTRY,
+                                _tree_to_npz_bytes(model.opt_state))
+                if model._rng is not None:
+                    # the dropout key stream position: without it a resumed
+                    # run's post-resume dropout masks diverge from an
+                    # uninterrupted run
+                    zf.writestr(RNG_ENTRY,
+                                _tree_to_npz_bytes(jnp.asarray(model._rng)))
+                if normalizer is not None:
+                    zf.writestr(NORMALIZER_ENTRY, serde.to_json(normalizer))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        # fsync the directory so the rename itself survives power loss
+        try:
+            dfd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:
+            pass  # e.g. directories aren't fsync-able on some filesystems
+    finally:
+        try:
+            os.unlink(tmp)
+        except FileNotFoundError:
+            pass
+
+
+def validate_checkpoint(path: str, deep: bool = False) -> dict:
+    """Up-front structural validation; returns the parsed metadata.
+
+    Raises :class:`CheckpointCorruptError` naming the offending entry for
+    anything unreadable; ``deep=True`` additionally CRC-checks every member
+    (reads the whole archive — used by CheckpointManager before trusting a
+    checkpoint, skipped on the restore path which reads everything anyway).
+    """
+    try:
+        with zipfile.ZipFile(path, "r") as zf:
+            names = set(zf.namelist())
+            for entry in REQUIRED_ENTRIES:
+                if entry not in names:
+                    raise CheckpointCorruptError(
+                        f"checkpoint {path!r}: missing required entry "
+                        f"{entry!r} (truncated or not a model checkpoint)")
+            if deep:
+                bad = zf.testzip()
+                if bad is not None:
+                    raise CheckpointCorruptError(
+                        f"checkpoint {path!r}: entry {bad!r} fails its CRC "
+                        "(truncated or corrupt archive)")
+            try:
+                meta = json.loads(zf.read(META_ENTRY))
+            except (ValueError, *_READ_ERRORS) as e:
+                raise CheckpointCorruptError(
+                    f"checkpoint {path!r}: entry {META_ENTRY!r} is "
+                    f"unreadable ({e})") from e
+    except zipfile.BadZipFile as e:
+        raise CheckpointCorruptError(
+            f"checkpoint {path!r}: not a readable ZIP archive ({e})") from e
+    fv = meta.get("format_version")
+    if not isinstance(fv, int) or not (1 <= fv <= FORMAT_VERSION):
+        raise CheckpointCorruptError(
+            f"checkpoint {path!r}: unsupported format_version {fv!r} in "
+            f"{META_ENTRY!r} (this build reads versions 1..{FORMAT_VERSION})")
+    if meta.get("model_class") not in ("MultiLayerNetwork",
+                                       "ComputationGraph"):
+        raise CheckpointCorruptError(
+            f"checkpoint {path!r}: unknown model_class "
+            f"{meta.get('model_class')!r} in {META_ENTRY!r}")
+    return meta
+
+
+def _read_entry(zf: zipfile.ZipFile, path: str, entry: str) -> bytes:
+    try:
+        return zf.read(entry)
+    except _READ_ERRORS as e:
+        raise CheckpointCorruptError(
+            f"checkpoint {path!r}: entry {entry!r} is unreadable "
+            f"({type(e).__name__}: {e})") from e
 
 
 def restore_model(path: str, load_updater: bool = True):
     """Rebuild a network from a checkpoint (reference
     restoreMultiLayerNetwork/restoreComputationGraph:137+; model type is
-    sniffed from metadata like ModelGuesser)."""
+    sniffed from metadata like ModelGuesser).
+
+    Validates format_version and required entries up front and raises
+    :class:`CheckpointCorruptError` for truncated/corrupt archives;
+    array-vs-config mismatches still raise :class:`ValueError`.
+    """
     from ..nn.conf.builders import MultiLayerConfiguration
     from ..nn.conf.graph_conf import ComputationGraphConfiguration
     from ..nn.graph.graph import ComputationGraph
     from ..nn.multilayer import MultiLayerNetwork
 
+    meta = validate_checkpoint(path)
     with zipfile.ZipFile(path, "r") as zf:
-        meta = json.loads(zf.read(META_ENTRY))
-        conf_json = zf.read(CONFIG_ENTRY).decode("utf-8")
+        conf_json = _read_entry(zf, path, CONFIG_ENTRY).decode("utf-8")
         dtype = jnp.dtype(meta["dtype"])
         if meta["model_class"] == "MultiLayerNetwork":
             conf = MultiLayerConfiguration.from_json(conf_json)
@@ -138,20 +252,44 @@ def restore_model(path: str, load_updater: bool = True):
         else:
             conf = ComputationGraphConfiguration.from_json(conf_json)
             model = ComputationGraph(conf).init(dtype=dtype)
-        model.params_tree = _npz_bytes_to_tree(zf.read(PARAMS_ENTRY),
-                                               model.params_tree)
-        model.state_tree = _npz_bytes_to_tree(zf.read(STATE_ENTRY),
-                                              model.state_tree)
-        if load_updater and meta.get("has_updater") and \
-                UPDATER_ENTRY in zf.namelist():
-            model.opt_state = _npz_bytes_to_tree(zf.read(UPDATER_ENTRY),
-                                                 model.opt_state)
-        model.iteration = meta.get("iteration", 0)
-        model.epoch = meta.get("epoch", 0)
-        if RNG_ENTRY in zf.namelist():
-            model._rng = _npz_bytes_to_tree(zf.read(RNG_ENTRY),
-                                            jnp.asarray(model._rng))
+        _load_state_from_zip(model, zf, path, meta, load_updater)
     return model
+
+
+def _load_state_from_zip(model, zf: zipfile.ZipFile, path: str, meta: dict,
+                         load_updater: bool) -> None:
+    """Load params/state/updater/counters/RNG from an open checkpoint into
+    an already-initialized model (shared by restore_model and in-place
+    restore for auto-resume/rollback)."""
+    model.params_tree = _npz_bytes_to_tree(
+        _read_entry(zf, path, PARAMS_ENTRY), model.params_tree)
+    model.state_tree = _npz_bytes_to_tree(
+        _read_entry(zf, path, STATE_ENTRY), model.state_tree)
+    names = zf.namelist()
+    if load_updater and meta.get("has_updater") and UPDATER_ENTRY in names:
+        model.opt_state = _npz_bytes_to_tree(
+            _read_entry(zf, path, UPDATER_ENTRY), model.opt_state)
+    model.iteration = meta.get("iteration", 0)
+    model.epoch = meta.get("epoch", 0)
+    if RNG_ENTRY in names and model._rng is not None:
+        model._rng = _npz_bytes_to_tree(
+            _read_entry(zf, path, RNG_ENTRY), jnp.asarray(model._rng))
+
+
+def load_checkpoint_state(model, path: str, load_updater: bool = True) -> dict:
+    """In-place restore: load a checkpoint's training state into an
+    EXISTING initialized model of the same architecture (no rebuild, so
+    precompiled dispatch tables and listeners survive). Returns the
+    checkpoint metadata. Raises :class:`CheckpointCorruptError` for
+    unreadable archives, :class:`ValueError` for architecture mismatches.
+    """
+    meta = validate_checkpoint(path)
+    with zipfile.ZipFile(path, "r") as zf:
+        _load_state_from_zip(model, zf, path, meta, load_updater)
+    # any cached recurrent carry belongs to the pre-restore trajectory
+    if hasattr(model, "_rnn_carry"):
+        model._rnn_carry = None
+    return meta
 
 
 def restore_normalizer(path: str):

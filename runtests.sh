@@ -21,3 +21,9 @@ JAX_PLATFORMS=cpu python tests/smoke_observability.py
 # second process reports cache HITS (warm start from disk, no XLA
 # recompile) with both runs under the wall ceiling.
 JAX_PLATFORMS=cpu python tests/smoke_compile_cache.py
+
+# Resilience smoke (docs/robustness.md): SIGKILL a fitting child
+# mid-checkpoint-write via the checkpoint.write fault point, auto-resume
+# in a second process, and assert bitwise-identical params vs an
+# uninterrupted same-seed control run.
+JAX_PLATFORMS=cpu python tests/smoke_resilience.py

@@ -1,0 +1,55 @@
+"""Subprocess body for the kill-and-resume tests (test_resilience.py's
+slow TestKillResume and tests/smoke_resilience.py).
+
+Usage: resilience_worker.py <ckpt_dir> <out_npz|/dev/null> <fresh|resume>
+
+Trains a fixed deterministic tiny net for 3 epochs x 8 batches with a
+per-iteration CheckpointManager in <ckpt_dir>. ``fresh`` starts from
+scratch (the driver may arm DL4JTPU_FAULT_CHECKPOINT_WRITE="kill:N" to
+SIGKILL this process mid-checkpoint-write); ``resume`` restores the
+newest valid checkpoint and completes the run. On success, writes final
+params/iteration/epoch to <out_npz> and prints DONE.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from deeplearning4j_tpu import (Adam, DenseLayer, InputType,
+                                MultiLayerNetwork, NeuralNetConfiguration,
+                                OutputLayer)
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.optimize.resilience import CheckpointManager
+
+
+def main():
+    ckpt_dir, out, mode = sys.argv[1:4]
+    assert mode in ("fresh", "resume"), mode
+
+    conf = (NeuralNetConfiguration.builder().seed(7)
+            .updater(Adam(0.05)).list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(42)
+    x = rng.normal(size=(64, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, size=64)]
+
+    mgr = CheckpointManager(ckpt_dir, save_every_n_iterations=1,
+                            keep_last=5)
+    net.fit(DataSet(x, y), epochs=3, batch_size=8,
+            checkpoint=mgr, resume=(mode == "resume"))
+
+    if out != "/dev/null":
+        np.savez(out, params=np.asarray(net.params()),
+                 iteration=int(net.iteration), epoch=int(net.epoch))
+    print("DONE", int(net.iteration), int(net.epoch))
+
+
+if __name__ == "__main__":
+    main()
