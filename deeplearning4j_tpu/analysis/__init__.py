@@ -1,0 +1,40 @@
+"""jaxlint: repo-native static analysis for trace purity, recompile
+churn, donation misuse, hidden host syncs, and lock discipline.
+
+JAX's trace-then-compile model makes a whole class of bugs *silent*:
+impure Python inside a jitted function bakes in stale values at trace
+time, a hidden ``float(tracer_output)`` stalls the async dispatch
+pipeline, an unhashable static argument recompiles every step, and a
+donated buffer read after the donating call dies with "Array has been
+deleted" only on real hardware. Meanwhile the threaded subsystems
+(prefetch, ParallelWrapper, parameter server, MetricsRegistry) enforce
+their lock discipline only by convention. This package turns those
+conventions into a commit-time gate:
+
+* :mod:`.boundaries` — jit-boundary inference: which functions get
+  traced (decorators, ``jax.jit(f)`` call sites, ``lax.scan`` bodies,
+  the lazy ``__getattr__`` jit builders in ``nn/multilayer.py`` /
+  ``nn/graph/graph.py``, plus one level of transitive callees).
+* :mod:`.rules` — the rule registry (ids JLxxx, severities, fix hints,
+  ``# jaxlint: disable=RULE`` suppression).
+* :mod:`.engine` — per-file AST orchestration producing findings.
+* :mod:`.baseline` — grandfathered-finding store so the CI gate fails
+  only on NEW findings (``analysis/baseline.json``).
+* :mod:`.tracecheck` — runtime shim that counts implicit device->host
+  syncs into the metrics registry (``host_syncs_total{site}``) so a
+  static finding can be confirmed live.
+
+CLI::
+
+    python -m deeplearning4j_tpu.analysis [paths...] \
+        [--format text|json] [--baseline FILE] [--write-baseline]
+
+Exit code 0 means no findings beyond the baseline. See
+docs/static_analysis.md for the rule catalog and workflow.
+"""
+from .engine import Finding, analyze_paths, analyze_source  # noqa: F401
+from .rules import RULES, rule_catalog  # noqa: F401
+from .baseline import Baseline  # noqa: F401
+
+__all__ = ["Finding", "analyze_paths", "analyze_source", "RULES",
+           "rule_catalog", "Baseline"]

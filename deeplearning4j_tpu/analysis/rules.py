@@ -1,0 +1,771 @@
+"""The jaxlint rule registry.
+
+Each rule is a :class:`Rule` with a stable id, a severity, a one-line
+fix hint, and a ``check(ctx)`` generator yielding ``(node, message)``
+pairs. The engine turns those into findings, applies ``# jaxlint:
+disable=RULE`` suppressions, and matches them against the baseline.
+
+Rule families
+-------------
+* JL0xx  trace purity — impure Python inside jit-reachable code bakes
+  stale values into the compiled executable.
+* JL1xx  hidden host syncs — implicit device->host transfers inside hot
+  paths (``fit`` / step loops / listener callbacks) that stall JAX's
+  async dispatch pipeline.
+* JL2xx  recompile hazards — things that change the jit cache key (or
+  crash hashing) every call.
+* JL3xx  buffer donation misuse.
+* JL4xx  lock discipline in threaded subsystems (RacerD-style
+  consistent-guard checking).
+
+Hotness is lexical: a function is *hot* if its name looks like a
+training/step/iterator path (or a listener callback), or if it is
+nested inside one. Jit-reachability comes from :mod:`.boundaries`.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterator, List, Optional, Set, Tuple
+
+from .boundaries import dotted_name
+
+# --------------------------------------------------------------------------
+# shared vocabularies
+# --------------------------------------------------------------------------
+
+#: function names considered hot paths for the host-sync rules
+HOT_NAME_RE = re.compile(
+    r"(^|_)(fit|train|step|batch|epoch|iterate|forward|backward|update|"
+    r"pump|producer|consumer|worker|prefetch)($|_)|"
+    r"^(__next__|__iter__)$")
+
+#: listener / callback entry points whose whole body is per-step hot
+CALLBACK_NAMES = {
+    "iteration_done", "on_epoch_start", "on_epoch_end",
+    "on_forward_pass", "on_backward_pass", "on_gradient_calculation",
+    "epoch_done",
+}
+
+#: loop-index-ish receivers that float()/int() legitimately touches
+_INDEXY = {
+    "iteration", "epoch", "i", "j", "k", "idx", "n", "step", "step_num",
+    "num_examples", "count", "batch_size", "num_batches", "total",
+    "iteration_count", "epoch_count", "seed", "size", "length",
+}
+
+_TIME_CALLS = {
+    "time.time", "time.perf_counter", "time.monotonic", "time.time_ns",
+    "time.perf_counter_ns", "time.monotonic_ns", "time.process_time",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+}
+
+_LOG_METHODS = {"debug", "info", "warning", "warn", "error", "critical",
+                "exception", "log"}
+_LOGGERISH = re.compile(r"(^|_)(log|logger)(ger)?s?$", re.IGNORECASE)
+
+_ARRAY_CTORS = {"array", "asarray", "ones", "zeros", "arange", "linspace",
+                "full", "eye", "identity"}
+
+_LOCKISH = re.compile(r"lock|mutex|cond|(^|_)cv($|_)|sem", re.IGNORECASE)
+
+_SYNC_PRIMITIVE_CTORS = {"Lock", "RLock", "Condition", "Event", "Semaphore",
+                         "BoundedSemaphore", "Barrier", "Queue", "LifoQueue",
+                         "PriorityQueue", "SimpleQueue", "deque"}
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    severity: str          # error | warning | info
+    title: str
+    hint: str
+    check: Callable[["object"], Iterator[Tuple[ast.AST, str]]]
+
+    def describe(self) -> dict:
+        return {"id": self.id, "severity": self.severity,
+                "title": self.title, "hint": self.hint}
+
+
+def _name_of(node: ast.AST) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return ""
+
+
+def _is_self_attr(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self")
+
+
+def _walk_no_nested(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function body without descending into nested defs/classes
+    (their hotness / reachability is judged separately)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# --------------------------------------------------------------------------
+# JL0xx — trace purity
+# --------------------------------------------------------------------------
+
+def _check_impure_random(ctx):
+    for fn in ctx.jit.reachable:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            d = ctx.dotted(node.func)
+            if not d:
+                continue
+            parts = d.split(".")
+            if parts[:2] == ["numpy", "random"] or (
+                    parts[0] == "random" and len(parts) > 1):
+                yield node, (f"call to '{d}' inside jit-reachable "
+                             f"code is evaluated once at trace time, not "
+                             f"per step")
+
+
+def _check_impure_time(ctx):
+    for fn in ctx.jit.reachable:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                d = ctx.dotted(node.func)
+                if d in _TIME_CALLS:
+                    yield node, (f"'{d}()' inside jit-reachable code is "
+                                 f"frozen at trace time")
+
+
+def _check_impure_io(ctx):
+    for fn in ctx.jit.reachable:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Name) and f.id == "print":
+                yield node, ("'print' inside jit-reachable code runs once "
+                             "at trace time (use jax.debug.print)")
+            elif isinstance(f, ast.Attribute) and f.attr in _LOG_METHODS:
+                base = _name_of(f.value)
+                d = ctx.dotted(f) or ""
+                if d.startswith("logging.") or _LOGGERISH.search(base or ""):
+                    yield node, (f"logging call '{d or base + '.' + f.attr}' "
+                                 f"inside jit-reachable code runs once at "
+                                 f"trace time")
+
+
+def _check_trace_mutation(ctx):
+    for fn in ctx.jit.reachable:
+        globals_declared: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Global):
+                globals_declared.update(node.names)
+        for node in ast.walk(fn):
+            targets: List[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            for tgt in targets:
+                if _is_self_attr(tgt):
+                    yield tgt, (f"write to 'self.{tgt.attr}' inside "
+                                f"jit-reachable code mutates host state at "
+                                f"trace time only")
+                elif isinstance(tgt, ast.Name) and tgt.id in globals_declared:
+                    yield tgt, (f"write to global '{tgt.id}' inside "
+                                f"jit-reachable code happens at trace time "
+                                f"only")
+
+
+def _static_param_names(ctx, fn) -> Set[str]:
+    """Parameter names marked static for this traced function — from a
+    recorded jit assignment whose fn_name matches, or from a
+    ``@functools.partial(jax.jit, static_argnums/static_argnames=...)``
+    decorator on the function itself."""
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return set()
+    params = [a.arg for a in fn.args.args]
+    out: Set[str] = set()
+
+    def add_positions(positions):
+        for pos in positions:
+            if 0 <= pos < len(params):
+                out.add(params[pos])
+
+    for asg in ctx.jit.assignments:
+        if asg.fn_name == fn.name:
+            add_positions(asg.static_argnums)
+            out.update(asg.static_argnames)
+    from .boundaries import _int_tuple, _kw, _str_tuple
+    for dec in fn.decorator_list:
+        if not isinstance(dec, ast.Call):
+            continue
+        add_positions(_int_tuple(_kw(dec, "static_argnums")))
+        out.update(_str_tuple(_kw(dec, "static_argnames")))
+    return out
+
+
+_STATICISH_PARAMS = {"self", "train", "training", "is_training",
+                     "deterministic", "mode", "axis", "axis_name",
+                     "reduction"}
+
+
+def _is_none_check(test: ast.AST) -> bool:
+    return (isinstance(test, ast.Compare)
+            and any(isinstance(op, (ast.Is, ast.IsNot))
+                    for op in test.ops))
+
+
+def _metadata_access(ctx, name_node: ast.AST) -> bool:
+    """Branching on ``x.ndim`` / ``x.shape`` is branching on trace-time
+    host metadata, not tracer truthiness."""
+    parent = ctx.parent(name_node)
+    return (isinstance(parent, ast.Attribute)
+            and parent.attr in ("ndim", "shape", "dtype", "size"))
+
+
+def _inside_none_check(ctx, node: ast.AST, stop: ast.AST) -> bool:
+    """Is this name used under an ``is None`` / ``is not None`` compare
+    somewhere inside the test expression (e.g. ``a and rng is not None``)?"""
+    cur = node
+    while cur is not None:
+        if _is_none_check(cur):
+            return True
+        if cur is stop:
+            return False
+        cur = ctx.parent(cur)
+    return False
+
+
+def _check_tracer_branch(ctx):
+    # Direct roots only: transitive callees are too often host helpers.
+    for fn in ctx.jit.roots:
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        params = {a.arg for a in fn.args.args} - _STATICISH_PARAMS \
+            - _static_param_names(ctx, fn)
+        if not params:
+            continue
+        for node in _walk_no_nested(fn):
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            test = node.test
+            if _is_none_check(test):
+                continue
+            if any(isinstance(sub, ast.Call) and
+                   _name_of(sub.func) == "isinstance"
+                   for sub in ast.walk(test)):
+                continue
+            hits = [sub.id for sub in ast.walk(test)
+                    if isinstance(sub, ast.Name)
+                    and isinstance(sub.ctx, ast.Load)
+                    and sub.id in params
+                    and not _inside_none_check(ctx, sub, test)
+                    and not _metadata_access(ctx, sub)]
+            if hits:
+                yield test, (f"Python branch on traced argument "
+                             f"'{hits[0]}' — use jax.lax.cond/select, or "
+                             f"mark it static")
+
+
+# --------------------------------------------------------------------------
+# JL1xx — hidden host syncs (hot paths)
+# --------------------------------------------------------------------------
+
+def _indexy(node: ast.AST) -> bool:
+    name = _name_of(node)
+    return name in _INDEXY or name.endswith(("_count", "_idx", "_index"))
+
+
+def _in_loop(ctx, node: ast.AST, fn: ast.AST) -> bool:
+    cur = ctx.parent(node)
+    while cur is not None and cur is not fn:
+        if isinstance(cur, (ast.For, ast.While, ast.AsyncFor, ast.ListComp,
+                            ast.SetComp, ast.DictComp, ast.GeneratorExp)):
+            return True
+        cur = ctx.parent(cur)
+    return False
+
+
+def _hot_sites(ctx, fn) -> Iterator[ast.AST]:
+    """Per-step-hot nodes in a hot function: the whole body of a listener
+    callback / ``__next__`` (called once per iteration from outside), or
+    nodes under a loop for ordinary fit/step/train functions."""
+    whole_body = getattr(fn, "name", "") in CALLBACK_NAMES or \
+        getattr(fn, "name", "") in ("__next__",)
+    for node in _walk_no_nested(fn):
+        if whole_body or _in_loop(ctx, node, fn):
+            yield node
+
+
+#: value-producing calls that read host state, not device buffers
+_HOST_VALUE_METHODS = {"get", "pop", "integers", "randint", "choice",
+                       "random", "uniform", "normal"}
+_HOST_VALUE_FUNCS = {"len", "round", "min", "max", "sum", "abs", "ord",
+                     "time", "perf_counter", "monotonic", "getattr"}
+
+
+def _shape_read(arg: ast.AST) -> bool:
+    for sub in ast.walk(arg):
+        if isinstance(sub, ast.Attribute) and sub.attr in ("shape", "ndim"):
+            return True
+        if isinstance(sub, ast.Name) and sub.id == "shape":
+            return True
+    return False
+
+
+def _check_host_scalar_sync(ctx):
+    for fn in ctx.hot_functions():
+        params = {a.arg for a in fn.args.args} if \
+            isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) else set()
+        for node in _hot_sites(ctx, fn):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in ("float", "int", "bool")
+                    and len(node.args) == 1 and not node.keywords):
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) or _indexy(arg):
+                continue
+            if isinstance(arg, ast.Name) and arg.id in params:
+                continue  # coercing a host-side argument, not a device read
+            if isinstance(arg, ast.Call) and (
+                    _name_of(arg.func) in _HOST_VALUE_FUNCS or
+                    (isinstance(arg.func, ast.Attribute)
+                     and arg.func.attr in _HOST_VALUE_METHODS)):
+                continue
+            if isinstance(arg, (ast.BinOp, ast.BoolOp)):
+                continue  # arithmetic on host scalars, not a device read
+            if _shape_read(arg):
+                continue  # shapes are host metadata
+            desc = ast.unparse(arg) if hasattr(ast, "unparse") else "value"
+            yield node, (f"'{node.func.id}({desc})' in hot path may block "
+                         f"on device->host transfer every step")
+
+
+def _check_item_sync(ctx):
+    for fn in ctx.hot_functions():
+        for node in _hot_sites(ctx, fn):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("item", "tolist")
+                    and not node.args and not node.keywords):
+                yield node, (f"'.{node.func.attr}()' in hot path forces a "
+                             f"device->host sync every step")
+
+
+_ASARRAY_CALLS = {"numpy.asarray", "numpy.array", "jax.device_get"}
+
+
+def _check_asarray_sync(ctx):
+    for fn in ctx.hot_functions():
+        for node in _hot_sites(ctx, fn):
+            if isinstance(node, ast.Call):
+                d = ctx.dotted(node.func)
+                if d in _ASARRAY_CALLS:
+                    yield node, (f"'{d}()' in hot path copies device memory "
+                                 f"to host; batch or fence it once per step")
+
+
+# --------------------------------------------------------------------------
+# JL2xx — recompile hazards
+# --------------------------------------------------------------------------
+
+_UNHASHABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                        ast.DictComp, ast.SetComp, ast.GeneratorExp)
+
+
+def _jit_target_map(ctx) -> Dict[str, object]:
+    return {asg.target_name: asg for asg in ctx.jit.assignments
+            if asg.static_argnums}
+
+
+def _check_unhashable_static(ctx):
+    targets = _jit_target_map(ctx)
+    if not targets:
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = None
+        if isinstance(node.func, ast.Name):
+            name = node.func.id
+        elif _is_self_attr(node.func):
+            name = node.func.attr
+        asg = targets.get(name)
+        if asg is None:
+            continue
+        for pos in asg.static_argnums:
+            if pos < len(node.args) and \
+                    isinstance(node.args[pos], _UNHASHABLE_LITERALS):
+                yield node.args[pos], (
+                    f"unhashable literal passed at static position {pos} "
+                    f"of jitted '{name}' — raises TypeError or defeats the "
+                    f"jit cache; pass a tuple / hashable")
+
+
+def _module_array_constants(ctx) -> Set[str]:
+    out: Set[str] = set()
+    body = getattr(ctx.tree, "body", [])
+    for stmt in body:
+        if not (isinstance(stmt, ast.Assign)
+                and isinstance(stmt.value, ast.Call)):
+            continue
+        d = ctx.dotted(stmt.value.func) or ""
+        parts = d.split(".")
+        if parts[-1] in _ARRAY_CTORS and (
+                parts[0] in ("numpy", "jax") or len(parts) == 1):
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name):
+                    out.add(tgt.id)
+    return out
+
+
+def _check_array_closure(ctx):
+    consts = _module_array_constants(ctx)
+    if not consts:
+        return
+    for fn in ctx.jit.reachable:
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.Lambda)):
+            continue
+        local: Set[str] = set()
+        if not isinstance(fn, ast.Lambda):
+            local = {a.arg for a in fn.args.args}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+                local.add(node.id)
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)
+                    and node.id in consts and node.id not in local):
+                yield node, (f"module-level array '{node.id}' closed over "
+                             f"by jit-reachable code constant-folds into "
+                             f"the executable; pass it as an argument")
+
+
+def _check_shape_fstring(ctx):
+    for fn in ctx.hot_functions():
+        for node in _walk_no_nested(fn):
+            shapey = None
+            if isinstance(node, ast.JoinedStr):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Attribute) and \
+                            sub.attr in ("shape", "dtype"):
+                        shapey = sub
+                        break
+            elif (isinstance(node, ast.Call)
+                  and isinstance(node.func, ast.Name)
+                  and node.func.id == "str" and node.args
+                  and isinstance(node.args[0], ast.Attribute)
+                  and node.args[0].attr in ("shape", "dtype")):
+                shapey = node.args[0]
+            if shapey is not None:
+                yield node, (f"shape/dtype-derived string built in hot "
+                             f"path (per-step formatting; a classic "
+                             f"recompile-churn cache key)")
+
+
+# --------------------------------------------------------------------------
+# JL3xx — donation misuse
+# --------------------------------------------------------------------------
+
+def _check_donation_reuse(ctx):
+    donate_map = {asg.target_name: asg for asg in ctx.jit.assignments
+                  if asg.donate_argnums}
+    if not donate_map:
+        return
+    for fn in ctx.functions():
+        aliases: Dict[str, str] = {}   # local name -> jitted target name
+        donated: Dict[str, int] = {}   # identifier -> donating-call lineno
+        reassigned: Dict[str, int] = {}
+
+        def ident(node) -> Optional[str]:
+            if isinstance(node, ast.Name):
+                return node.id
+            if _is_self_attr(node):
+                return f"self.{node.attr}"
+            return None
+
+        # same-line ordering matters: the donating call completes first,
+        # then reads happen (``return self.params`` reads on the return's
+        # own line), then stores clear, then the return severs tracking
+        # between mutually exclusive branches
+        _PRIO = {"donate": 0, "load": 1, "assign": 2, "return": 3}
+        events: List[Tuple[int, int, str, ast.AST]] = []
+
+        def emit(lineno: int, kind: str, node: ast.AST) -> None:
+            events.append((lineno, _PRIO[kind.split(":")[0]], kind, node))
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Return):
+                emit(node.lineno, "return", node)
+            if isinstance(node, ast.Assign):
+                src = ident(node.value)
+                for tgt in node.targets:
+                    names = [tgt]
+                    if isinstance(tgt, (ast.Tuple, ast.List)):
+                        names = list(tgt.elts)
+                    for t in names:
+                        tid = ident(t)
+                        if tid is None:
+                            continue
+                        emit(node.lineno, "assign", t)
+                        if isinstance(t, ast.Name):
+                            if src in donate_map:
+                                aliases[t.id] = src
+                            else:
+                                aliases.pop(t.id, None)
+            if isinstance(node, ast.Call):
+                name = None
+                if isinstance(node.func, ast.Name):
+                    name = aliases.get(node.func.id, node.func.id)
+                elif _is_self_attr(node.func):
+                    name = node.func.attr
+                asg = donate_map.get(name)
+                if asg is not None:
+                    # donation takes effect after the whole (possibly
+                    # multi-line) call — its own argument loads are fine
+                    effect_line = getattr(node, "end_lineno", None) or \
+                        node.lineno
+                    for pos in asg.donate_argnums:
+                        if pos < len(node.args):
+                            aid = ident(node.args[pos])
+                            if aid:
+                                emit(effect_line, f"donate:{aid}", node)
+            if isinstance(node, (ast.Name, ast.Attribute)) and \
+                    isinstance(getattr(node, "ctx", None), ast.Load):
+                aid = ident(node)
+                if aid:
+                    emit(node.lineno, f"load:{aid}", node)
+
+        events.sort(key=lambda e: (e[0], e[1]))
+        for lineno, _prio, kind, node in events:
+            if kind == "return":
+                donated.clear()
+            elif kind.startswith("donate:"):
+                donated.setdefault(kind[7:], lineno)
+            elif kind == "assign":
+                aid = ident(node)
+                if aid in donated and lineno > donated[aid]:
+                    donated.pop(aid, None)
+            elif kind.startswith("load:"):
+                aid = kind[5:]
+                if aid in donated and lineno > donated[aid]:
+                    yield node, (f"'{aid}' read after being donated to a "
+                                 f"jitted call (line {donated[aid]}); the "
+                                 f"buffer is deleted on real hardware")
+                    donated.pop(aid, None)
+
+
+# --------------------------------------------------------------------------
+# JL4xx — lock discipline
+# --------------------------------------------------------------------------
+
+def _thread_entry_points(cls: ast.ClassDef,
+                         methods: Dict[str, ast.FunctionDef]) -> Set[str]:
+    entries: Set[str] = set()
+    for base in cls.bases:
+        if _name_of(base) == "Thread" and "run" in methods:
+            entries.add("run")
+    for m in methods.values():
+        for node in ast.walk(m):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = _name_of(node.func)
+            if fname == "Thread":
+                for kw in node.keywords:
+                    if kw.arg == "target" and _is_self_attr(kw.value) and \
+                            kw.value.attr in methods:
+                        entries.add(kw.value.attr)
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "submit":
+                if node.args and _is_self_attr(node.args[0]) and \
+                        node.args[0].attr in methods:
+                    entries.add(node.args[0].attr)
+    return entries
+
+
+def _guard_of(ctx, node) -> Optional[str]:
+    """Name of the self.<lock-ish> attribute whose ``with`` block encloses
+    this node, or None."""
+    cur = ctx.parent(node)
+    while cur is not None and not isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+        if isinstance(cur, ast.With):
+            for item in cur.items:
+                expr = item.context_expr
+                if isinstance(expr, ast.Call):
+                    expr = expr.func
+                if _is_self_attr(expr) and _LOCKISH.search(expr.attr):
+                    return expr.attr
+        cur = ctx.parent(cur)
+    return None
+
+
+def _sync_primitive_attrs(init: Optional[ast.FunctionDef], ctx) -> Set[str]:
+    out: Set[str] = set()
+    if init is None:
+        return out
+    for node in ast.walk(init):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            d = (ctx.dotted(node.value.func) or "").split(".")[-1]
+            if d in _SYNC_PRIMITIVE_CTORS:
+                for tgt in node.targets:
+                    if _is_self_attr(tgt):
+                        out.add(tgt.attr)
+    return out
+
+
+def _check_lock_discipline(ctx):
+    for cls in ctx.classes():
+        methods = {n.name: n for n in cls.body
+                   if isinstance(n, ast.FunctionDef)}
+        entries = _thread_entry_points(cls, methods)
+        if not entries:
+            continue
+        # thread side = entry points + one level of same-class callees
+        thread_side: Set[str] = set(entries)
+        for name in list(entries):
+            fn = methods.get(name)
+            if fn is None:
+                continue
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) and \
+                        _is_self_attr(node.func) and \
+                        node.func.attr in methods:
+                    thread_side.add(node.func.attr)
+        main_side = set(methods) - thread_side - {"__init__"}
+        exempt = _sync_primitive_attrs(methods.get("__init__"), ctx)
+
+        def attr_events(names: Set[str], want_store: bool):
+            for mname in names:
+                fn = methods.get(mname)
+                if fn is None:
+                    continue
+                for node in ast.walk(fn):
+                    tgts = []
+                    if isinstance(node, ast.Assign):
+                        tgts = node.targets
+                    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                        tgts = [node.target]
+                    if want_store:
+                        for t in tgts:
+                            sub = [t]
+                            if isinstance(t, (ast.Tuple, ast.List)):
+                                sub = list(t.elts)
+                            for s in sub:
+                                if _is_self_attr(s):
+                                    yield mname, s.attr, s
+                    elif isinstance(node, ast.Attribute) and \
+                            _is_self_attr(node) and \
+                            isinstance(node.ctx, ast.Load):
+                        yield mname, node.attr, node
+
+        thread_writes: Dict[str, List[Tuple[str, ast.AST]]] = {}
+        for mname, attr, node in attr_events(thread_side, True):
+            thread_writes.setdefault(attr, []).append((mname, node))
+        main_touch: Set[str] = set()
+        for _, attr, _n in attr_events(main_side, True):
+            main_touch.add(attr)
+        for _, attr, _n in attr_events(main_side, False):
+            main_touch.add(attr)
+
+        for attr, writes in sorted(thread_writes.items()):
+            if attr in exempt or attr.startswith("__"):
+                continue
+            writer_methods = {m for m, _ in writes}
+            shared = attr in main_touch or len(writer_methods) > 1
+            if not shared:
+                continue
+            guards = {_guard_of(ctx, node) for _, node in writes}
+            # main-side write sites must use the same guard too
+            main_writes = [(m, n) for m, a, n in attr_events(main_side, True)
+                           if a == attr]
+            guards |= {_guard_of(ctx, node) for _, node in main_writes}
+            if guards == {None}:
+                for mname, node in writes:
+                    yield node, (
+                        f"'{cls.name}.{attr}' is written from thread entry "
+                        f"'{mname}' and shared with other methods, with no "
+                        f"lock held at any write site")
+            elif None in guards or len(guards - {None}) > 1:
+                named = sorted(g for g in guards if g)
+                for mname, node in writes + main_writes:
+                    if _guard_of(ctx, node) is None or len(named) > 1:
+                        yield node, (
+                            f"'{cls.name}.{attr}' write in '{mname}' is not "
+                            f"consistently guarded (locks seen: "
+                            f"{', '.join(named) or 'none'})")
+
+
+# --------------------------------------------------------------------------
+# registry
+# --------------------------------------------------------------------------
+
+RULES: Tuple[Rule, ...] = (
+    Rule("JL001", "error", "impure-random",
+         "Use jax.random with an explicitly threaded PRNG key.",
+         _check_impure_random),
+    Rule("JL002", "warning", "impure-time",
+         "Read clocks outside the traced function and pass values in.",
+         _check_impure_time),
+    Rule("JL003", "warning", "impure-io",
+         "Use jax.debug.print, or log outside the traced function.",
+         _check_impure_io),
+    Rule("JL004", "error", "trace-mutation",
+         "Return new values from the traced function instead of mutating "
+         "self/globals.",
+         _check_trace_mutation),
+    Rule("JL005", "warning", "tracer-branch",
+         "Use jax.lax.cond/jnp.where, or declare the argument in "
+         "static_argnums.",
+         _check_tracer_branch),
+    Rule("JL101", "warning", "host-scalar-sync",
+         "Fence once per step (tracecheck.fenced_read / "
+         "block_until_ready) or read asynchronously off the hot path.",
+         _check_host_scalar_sync),
+    Rule("JL102", "warning", "item-sync",
+         "Batch .item()/.tolist() reads behind an explicit per-step fence.",
+         _check_item_sync),
+    Rule("JL103", "info", "host-copy",
+         "np.asarray/device_get copies device memory; hoist out of the "
+         "per-step loop or fence deliberately.",
+         _check_asarray_sync),
+    Rule("JL201", "error", "unhashable-static",
+         "Static arguments key the jit cache; pass tuples or other "
+         "hashables.",
+         _check_unhashable_static),
+    Rule("JL202", "warning", "array-closure",
+         "Pass module-level arrays as arguments so XLA doesn't "
+         "constant-fold them into the executable.",
+         _check_array_closure),
+    Rule("JL203", "warning", "shape-fstring",
+         "Hoist shape/dtype formatting out of the hot path (guard behind "
+         "a rate limiter or log level).",
+         _check_shape_fstring),
+    Rule("JL301", "error", "donation-reuse",
+         "Reassign or re-fetch the buffer from the call's outputs before "
+         "reading; donated inputs are deleted on device.",
+         _check_donation_reuse),
+    Rule("JL401", "warning", "lock-discipline",
+         "Guard every write with the same self.<lock>, or annotate a "
+         "documented atomic with '# jaxlint: atomic'.",
+         _check_lock_discipline),
+)
+
+RULES_BY_ID: Dict[str, Rule] = {r.id: r for r in RULES}
+
+
+def rule_catalog() -> List[dict]:
+    """Stable, docs-friendly listing of every rule."""
+    return [r.describe() for r in RULES]

@@ -1,0 +1,187 @@
+"""Runtime tracing-discipline checker: count implicit device->host syncs.
+
+Static analysis (JL1xx) can only *suspect* a hidden sync; this shim
+confirms it live. :func:`watch` wraps a value (typically a jit output)
+in a :class:`SyncSpy` proxy that behaves like the underlying array but
+increments ``host_syncs_total{site}`` in the PR 2 MetricsRegistry every
+time host Python implicitly forces a transfer — ``float()``, ``int()``,
+``bool()``, ``np.asarray()`` (via ``__array__``), ``.item()``,
+``.tolist()``. Handing the proxy back INTO jax is free: ``__jax_array__``
+unwraps without counting, so ``jit(f)(watch(x))`` doesn't self-report.
+
+Deliberate reads go through :func:`fenced_read`, which fences
+(``block_until_ready``) and copies without counting — the "I meant to
+pay this cost, once, here" spelling the JL101 fix-hint points at.
+
+Typical use in a step loop under test::
+
+    out = watch(train_step(batch), site="fit.loss")
+    ...
+    assert sync_count("fit.loss") == 0      # nothing implicitly synced
+    loss = fenced_read(out)                  # explicit, uncounted
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+METRIC_NAME = "host_syncs_total"
+
+try:
+    from ..optimize.metrics import registry as _registry
+except Exception:  # pragma: no cover - analysis must import standalone
+    _registry = None
+
+# Fallback tally used when the metrics registry is unavailable; also
+# mirrored unconditionally so tests can reset it cheaply.
+_local_counts: dict = {}
+
+
+def _count(site: str) -> None:
+    _local_counts[site] = _local_counts.get(site, 0) + 1
+    if _registry is not None:
+        try:
+            _registry().counter(
+                METRIC_NAME,
+                "implicit device->host syncs observed by tracecheck",
+            ).labels(site=site).inc()
+        except Exception:  # registry misconfiguration must not break math
+            pass
+
+
+def sync_count(site: Optional[str] = None) -> int:
+    """Observed implicit syncs (one site, or all sites when None)."""
+    if site is not None:
+        return _local_counts.get(site, 0)
+    return sum(_local_counts.values())
+
+
+def reset_counts() -> None:
+    _local_counts.clear()
+
+
+class SyncSpy:
+    """Array proxy that counts implicit host syncs.
+
+    Arithmetic, attributes (``shape``, ``dtype``, ``at``...), indexing
+    and jax re-entry all pass through uncounted; only the operations
+    that force a device->host transfer count.
+    """
+
+    __slots__ = ("_value", "_site")
+
+    def __init__(self, value: Any, site: str = "default"):
+        object.__setattr__(self, "_value", value)
+        object.__setattr__(self, "_site", site)
+
+    # -- uncounted passthrough -------------------------------------------
+    def __jax_array__(self):
+        # jax re-entry: tracing/dispatch on the proxy is not a host sync
+        return self._value
+
+    def __getattr__(self, name):
+        if name in ("item", "tolist"):
+            def counted(*args, **kwargs):
+                _count(self._site)
+                return getattr(self._value, name)(*args, **kwargs)
+            return counted
+        return getattr(self._value, name)
+
+    def __getitem__(self, key):
+        return self._value[key]
+
+    def __len__(self):
+        return len(self._value)
+
+    def __repr__(self):
+        return f"SyncSpy({self._value!r}, site={self._site!r})"
+
+    def unwrap(self) -> Any:
+        return self._value
+
+    # -- counted: implicit device->host transfers ------------------------
+    def __float__(self):
+        _count(self._site)
+        return float(self._value)
+
+    def __int__(self):
+        _count(self._site)
+        return int(self._value)
+
+    def __bool__(self):
+        _count(self._site)
+        return bool(self._value)
+
+    def __index__(self):
+        _count(self._site)
+        return self._value.__index__()
+
+    def __array__(self, *args, **kwargs):
+        _count(self._site)
+        import numpy as np
+        return np.asarray(self._value, *args, **kwargs)
+
+    # -- arithmetic defers to the wrapped value (uncounted; results are
+    # plain arrays, so downstream implicit syncs on them are the caller's
+    # own — wrap again with watch() to keep tracking) --------------------
+    def _binop(self, other, op):
+        if isinstance(other, SyncSpy):
+            other = other._value
+        return getattr(self._value, op)(other)
+
+    def __add__(self, o): return self._binop(o, "__add__")
+    def __radd__(self, o): return self._binop(o, "__radd__")
+    def __sub__(self, o): return self._binop(o, "__sub__")
+    def __rsub__(self, o): return self._binop(o, "__rsub__")
+    def __mul__(self, o): return self._binop(o, "__mul__")
+    def __rmul__(self, o): return self._binop(o, "__rmul__")
+    def __truediv__(self, o): return self._binop(o, "__truediv__")
+    def __rtruediv__(self, o): return self._binop(o, "__rtruediv__")
+    def __neg__(self): return -self._value
+
+
+def watch(value: Any, site: str = "default") -> Any:
+    """Wrap every array leaf of ``value`` in a :class:`SyncSpy`.
+
+    Scalars/strings/None pass through untouched; containers are wrapped
+    leaf-wise via jax.tree_util so a whole jit output pytree can be
+    watched in one call.
+    """
+    try:
+        import jax
+        is_leaf_array = lambda x: hasattr(x, "dtype") and hasattr(x, "shape")
+        return jax.tree_util.tree_map(
+            lambda leaf: SyncSpy(leaf, site) if is_leaf_array(leaf)
+            else leaf, value)
+    except Exception:
+        if hasattr(value, "dtype") and hasattr(value, "shape"):
+            return SyncSpy(value, site)
+        return value
+
+
+def wrap(fn: Callable, site: Optional[str] = None) -> Callable:
+    """Decorator: watch the outputs of ``fn`` under ``site`` (defaults
+    to the function's qualified name)."""
+    label = site or getattr(fn, "__qualname__", getattr(
+        fn, "__name__", "wrapped"))
+
+    def inner(*args, **kwargs):
+        return watch(fn(*args, **kwargs), site=label)
+
+    inner.__name__ = getattr(fn, "__name__", "wrapped")
+    inner.__qualname__ = f"tracecheck[{label}]"
+    inner.__wrapped__ = fn
+    return inner
+
+
+def fenced_read(value: Any):
+    """Deliberate, uncounted device->host read: fence then copy.
+
+    Accepts a raw array or a :class:`SyncSpy`; returns a numpy array
+    (0-d arrays come back as numpy scalars via ``np.asarray``)."""
+    import numpy as np
+    if isinstance(value, SyncSpy):
+        value = value.unwrap()
+    block = getattr(value, "block_until_ready", None)
+    if callable(block):
+        value = block()
+    return np.asarray(value)

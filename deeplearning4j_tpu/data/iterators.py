@@ -155,19 +155,29 @@ class MultipleEpochsIterator(DataSetIterator):
         return self._base.batch_size()
 
 
+class _StreamEnd:
+    """Queue-carried end-of-stream marker, optionally holding the
+    producer's error. Shipping the error inside the queue item (instead
+    of on a shared instance attribute) ties each epoch's error to its
+    own queue: a stale producer that outlived its 5s join timeout can
+    only write to the old queue, never poison the next epoch."""
+
+    __slots__ = ("error",)
+
+    def __init__(self, error: Optional[BaseException] = None):
+        self.error = error
+
+
 class AsyncDataSetIterator(DataSetIterator):
     """Background-thread prefetch with a bounded queue (reference
     datasets/iterator/AsyncDataSetIterator.java). `queue_size` mirrors the
     reference's buffer size (default 8)."""
-
-    _SENTINEL = object()
 
     def __init__(self, base: DataSetIterator, queue_size: int = 8):
         self._base = base
         self._queue_size = max(1, int(queue_size))
         self._queue: Optional[queue.Queue] = None
         self._thread: Optional[threading.Thread] = None
-        self._error: Optional[BaseException] = None
         self._shutdown = threading.Event()
 
     def _produce_item(self, ds, host_ms: float):
@@ -215,15 +225,13 @@ class AsyncDataSetIterator(DataSetIterator):
                 if self._shutdown.is_set():
                     return
                 q.put(self._produce_item(ds, host_ms))
-            q.put(self._SENTINEL)
-        except BaseException as e:  # propagate to consumer
-            self._error = e
-            q.put(self._SENTINEL)
+            q.put(_StreamEnd())
+        except BaseException as e:  # propagate to consumer via the queue
+            q.put(_StreamEnd(e))
 
     def reset(self):
         self._stop_thread()
         self._shutdown.clear()
-        self._error = None
         self._queue = queue.Queue(maxsize=self._queue_size)
         self._thread = threading.Thread(
             target=self._producer, args=(self._queue,), daemon=True)
@@ -248,11 +256,10 @@ class AsyncDataSetIterator(DataSetIterator):
         if self._queue is None:
             self.reset()
         item = self._queue.get()
-        if item is self._SENTINEL:
+        if isinstance(item, _StreamEnd):
             self._thread = None
-            if self._error is not None:
-                err, self._error = self._error, None
-                raise err
+            if item.error is not None:
+                raise item.error
             raise StopIteration
         return item
 

@@ -40,7 +40,8 @@ class ScoreIterationListener(IterationListener):
         if iteration % self.n == 0:
             self._printer(
                 f"Score at iteration {iteration} is "
-                f"{float(model.score_value):.6f}")
+                # deliberate rate-limited fence: printing IS the read
+                f"{float(model.score_value):.6f}")  # jaxlint: disable=JL101
 
 
 class PerformanceListener(IterationListener):
@@ -89,7 +90,7 @@ class PerformanceListener(IterationListener):
             # — nothing else may sync the dispatch queue)
             reg.gauge("train_score",
                       "Loss at the last fenced report").set(
-                          float(model.score_value))
+                          float(model.score_value))  # jaxlint: disable=JL101
         compiles = compilation_count()
         now = time.perf_counter()
         if self._last_time is not None and iteration > self._last_iter:
@@ -222,15 +223,30 @@ class ParamAndGradientIterationListener(IterationListener):
 
 class CollectScoresIterationListener(IterationListener):
     """Accumulate (iteration, score) pairs (reference
-    CollectScoresIterationListener)."""
+    CollectScoresIterationListener).
+
+    The callback stores the raw device scalar: a ``float()`` here would
+    fence the async dispatch queue on every collected iteration, serially
+    stalling the step pipeline (jaxlint JL101). Conversion to host floats
+    happens lazily on the first read of :attr:`scores` — one fence for
+    the whole batch of pending values, normally after fit returns.
+    """
 
     def __init__(self, frequency: int = 1):
         self.frequency = max(1, int(frequency))
-        self.scores: List[Tuple[int, float]] = []
+        self._raw = []
+        self._scores: List[Tuple[int, float]] = []
 
     def iteration_done(self, model, iteration):
         if iteration % self.frequency == 0:
-            self.scores.append((iteration, float(model.score_value)))
+            self._raw.append((iteration, model.score_value))
+
+    @property
+    def scores(self) -> List[Tuple[int, float]]:
+        if self._raw:
+            pending, self._raw = self._raw, []
+            self._scores.extend((i, float(s)) for i, s in pending)
+        return self._scores
 
 
 class EvaluativeListener(IterationListener):

@@ -145,6 +145,16 @@ class ParameterServer:
             pushes.labels(result="applied").inc()
             return True, self.version
 
+    def stats(self) -> dict:
+        """Consistent (version, applied, stale_drops) snapshot. The three
+        counters move together under ``_lock``; reading them attribute-by-
+        attribute from another thread (the HTTP stats route) can observe
+        a torn triple mid-push — e.g. the new version with the old
+        applied count."""
+        with self._lock:
+            return {"version": self.version, "applied": self.applied,
+                    "stale_drops": self.stale_drops}
+
 
 class ParameterServerTrainer:
     """Async DP fit loop (ParameterServerTrainerContext role): one
@@ -380,9 +390,7 @@ class ParameterServerHttpNode:
             return 200, {"applied": bool(applied), "version": version}
 
         def get_stats(_):
-            return 200, {"version": server.version,
-                         "applied": server.applied,
-                         "stale_drops": server.stale_drops}
+            return 200, server.stats()
 
         self._http = JsonHttpServer(
             get_routes={"/params": get_params, "/stats": get_stats},

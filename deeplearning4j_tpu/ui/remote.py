@@ -31,16 +31,24 @@ class RemoteStatsStorageRouter(StatsStorage):
         self.retries = int(retries)
         self.timeout = float(timeout)
         self._queue: "queue.Queue" = queue.Queue(maxsize=queue_size)
+        # dropped is bumped from both the caller thread (queue full) and
+        # the pump thread (retries exhausted): += is a read-modify-write,
+        # so both sites go through _drop() under this lock
+        self._drop_lock = threading.Lock()
         self.dropped = 0
         self._shutdown = False
         self._thread = threading.Thread(target=self._pump, daemon=True)
         self._thread.start()
 
+    def _drop(self) -> None:
+        with self._drop_lock:
+            self.dropped += 1
+
     def put_update(self, session_id: str, record: dict) -> None:
         try:
             self._queue.put_nowait({"session": session_id, **record})
         except queue.Full:
-            self.dropped += 1  # never stall training on a slow receiver
+            self._drop()  # never stall training on a slow receiver
 
     def _pump(self):
         while True:
@@ -58,7 +66,7 @@ class RemoteStatsStorageRouter(StatsStorage):
                         break
                     except Exception:
                         if attempt == self.retries - 1:
-                            self.dropped += 1
+                            self._drop()
             finally:
                 self._queue.task_done()
 

@@ -8,6 +8,12 @@ cd "$(dirname "$0")"
 
 make -C native || echo "native ETL build unavailable; numpy fallbacks"
 
+# jaxlint gate (docs/static_analysis.md): AST analysis of the whole
+# package against the committed analysis/baseline.json. Fails fast on
+# any NEW trace-purity / host-sync / recompile / donation / lock
+# finding — before spending minutes on the pytest suite.
+JAX_PLATFORMS=cpu python tests/smoke_analysis.py
+
 python -m pytest tests/ -q "$@"
 
 # Observability smoke (docs/observability.md): a real 2-epoch fit with

@@ -1,0 +1,53 @@
+"""jaxlint smoke: the shipped tree must be clean against the committed
+baseline (docs/static_analysis.md).
+
+Run by runtests.sh as a separate step (no test_ prefix on purpose —
+this is the commit gate itself, invoked exactly the way CI and humans
+invoke it: the module CLI over the whole package with the packaged
+baseline). Exits nonzero on any NEW finding, on a broken baseline file,
+and — loudly but separately — prints stale baseline entries so they get
+pruned rather than accumulate.
+
+Usage: python tests/smoke_analysis.py
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    from deeplearning4j_tpu.analysis.baseline import (Baseline,
+                                                      default_baseline_path)
+    from deeplearning4j_tpu.analysis.cli import main as jaxlint_main
+    from deeplearning4j_tpu.analysis.rules import RULES
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pkg = os.path.join(repo, "deeplearning4j_tpu")
+
+    # the committed baseline must parse and carry a justification per entry
+    bl = Baseline.load(default_baseline_path())
+    missing = [e.location for e in bl.entries if not e.justification]
+    if missing:
+        print(f"smoke_analysis: FAIL: {len(missing)} baseline entries "
+              f"lack a justification: {missing[:5]}")
+        return 1
+
+    assert len(RULES) >= 10, "rule registry shrank below the contract"
+
+    rc = jaxlint_main([pkg])
+    if rc != 0:
+        print("smoke_analysis: FAIL: new jaxlint findings above the "
+              "committed baseline (see output above); fix them or "
+              "baseline them with a justification")
+        return 1
+
+    print(f"smoke_analysis: OK ({len(RULES)} rules, "
+          f"{len(bl.entries)} baselined findings, 0 new)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

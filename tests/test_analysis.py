@@ -1,0 +1,737 @@
+"""jaxlint analyzer tests: one positive and one negative fixture per
+rule, jit-boundary inference against a miniature of the lazy
+``__getattr__`` builder pattern, baseline add/expire round-trip,
+suppression comments, CLI exit codes, and the tracecheck runtime shim.
+"""
+import ast
+import json
+import textwrap
+
+import pytest
+
+from deeplearning4j_tpu.analysis import boundaries
+from deeplearning4j_tpu.analysis.baseline import Baseline
+from deeplearning4j_tpu.analysis.engine import analyze_source
+from deeplearning4j_tpu.analysis.rules import RULES, RULES_BY_ID
+
+
+def findings_for(src, rule_id=None):
+    out = analyze_source(textwrap.dedent(src), path="fixture.py")
+    if rule_id is None:
+        return out
+    return [f for f in out if f.rule == rule_id]
+
+
+def ids_of(src):
+    return {f.rule for f in findings_for(src)}
+
+
+# ---------------------------------------------------------------------------
+# rule registry basics
+# ---------------------------------------------------------------------------
+
+class TestRegistry:
+    def test_at_least_ten_rules(self):
+        assert len(RULES) >= 10
+
+    def test_every_rule_has_metadata(self):
+        for r in RULES:
+            assert r.id.startswith("JL") and len(r.id) == 5
+            assert r.severity in ("error", "warning", "info")
+            assert r.hint and r.title
+
+    def test_ids_unique(self):
+        assert len(RULES_BY_ID) == len(RULES)
+
+
+# ---------------------------------------------------------------------------
+# JL0xx trace purity
+# ---------------------------------------------------------------------------
+
+class TestPurityRules:
+    def test_jl001_positive(self):
+        src = """
+            import jax
+            import numpy as np
+            @jax.jit
+            def f(x):
+                noise = np.random.normal(size=3)
+                return x + noise
+        """
+        assert findings_for(src, "JL001")
+
+    def test_jl001_negative_outside_jit(self):
+        src = """
+            import numpy as np
+            def sample(x):
+                return x + np.random.normal(size=3)
+        """
+        assert not findings_for(src, "JL001")
+
+    def test_jl002_positive(self):
+        src = """
+            import jax
+            import time as _time
+            @jax.jit
+            def step(x):
+                t0 = _time.perf_counter()
+                return x * t0
+        """
+        assert findings_for(src, "JL002")
+
+    def test_jl002_negative_host_side(self):
+        src = """
+            import time
+            def step_timer():
+                return time.perf_counter()
+        """
+        assert not findings_for(src, "JL002")
+
+    def test_jl003_positive_print_and_logger(self):
+        src = """
+            import jax
+            import logging
+            log = logging.getLogger(__name__)
+            @jax.jit
+            def f(x):
+                print("tracing", x)
+                log.info("x=%s", x)
+                return x
+        """
+        hits = findings_for(src, "JL003")
+        assert len(hits) == 2
+
+    def test_jl003_negative(self):
+        src = """
+            def report(x):
+                print("done", x)
+        """
+        assert not findings_for(src, "JL003")
+
+    def test_jl004_positive_self_write(self):
+        src = """
+            import jax
+            class M:
+                def build(self):
+                    self._step = jax.jit(self._impl)
+                def _impl(self, x):
+                    self.calls = 1
+                    return x
+        """
+        assert findings_for(src, "JL004")
+
+    def test_jl004_negative_untraced_method(self):
+        src = """
+            class M:
+                def bump(self):
+                    self.calls = 1
+        """
+        assert not findings_for(src, "JL004")
+
+    def test_jl005_positive(self):
+        src = """
+            import jax
+            @jax.jit
+            def f(x, flag):
+                if flag:
+                    return x
+                return -x
+        """
+        assert findings_for(src, "JL005")
+
+    def test_jl005_negative_static_argnames(self):
+        src = """
+            import functools
+            import jax
+            @functools.partial(jax.jit, static_argnames=("flag",))
+            def f(x, flag):
+                if flag:
+                    return x
+                return -x
+        """
+        assert not findings_for(src, "JL005")
+
+    def test_jl005_negative_none_check_in_boolop(self):
+        src = """
+            import jax
+            @jax.jit
+            def f(x, rng):
+                if x.ndim and rng is not None:
+                    return x
+                return -x
+        """
+        assert not findings_for(src, "JL005")
+
+
+# ---------------------------------------------------------------------------
+# JL1xx hidden host syncs
+# ---------------------------------------------------------------------------
+
+class TestSyncRules:
+    def test_jl101_positive(self):
+        src = """
+            def fit(model, data):
+                total = 0.0
+                for batch in data:
+                    total += float(model.score_value)
+                return total
+        """
+        assert findings_for(src, "JL101")
+
+    def test_jl101_negative_index_coercion(self):
+        src = """
+            def fit(model, data, epochs):
+                n = int(epochs)
+                for iteration in data:
+                    i = int(iteration)
+                return n
+        """
+        assert not findings_for(src, "JL101")
+
+    def test_jl101_callback_body_is_hot(self):
+        src = """
+            def iteration_done(model, iteration):
+                return float(model.score_value)
+        """
+        assert findings_for(src, "JL101")
+
+    def test_jl102_positive(self):
+        src = """
+            def train(batches):
+                out = []
+                for b in batches:
+                    out.append(b.loss.item())
+                return out
+        """
+        assert findings_for(src, "JL102")
+
+    def test_jl102_negative_cold_path(self):
+        src = """
+            def summarize(arr):
+                return arr.item()
+        """
+        assert not findings_for(src, "JL102")
+
+    def test_jl103_positive_in_loop(self):
+        src = """
+            import numpy as np
+            def fit(model, data):
+                for batch in data:
+                    host = np.asarray(batch)
+                return host
+        """
+        assert findings_for(src, "JL103")
+
+    def test_jl103_negative_entry_conversion(self):
+        src = """
+            import numpy as np
+            def fit(model, data):
+                data = np.asarray(data)
+                return data
+        """
+        assert not findings_for(src, "JL103")
+
+
+# ---------------------------------------------------------------------------
+# JL2xx recompile hazards
+# ---------------------------------------------------------------------------
+
+class TestRecompileRules:
+    def test_jl201_positive(self):
+        src = """
+            import jax
+            def g(sizes, x):
+                return x
+            step = jax.jit(g, static_argnums=(0,))
+            def run(x):
+                return step([1, 2], x)
+        """
+        assert findings_for(src, "JL201")
+
+    def test_jl201_negative_hashable(self):
+        src = """
+            import jax
+            def g(sizes, x):
+                return x
+            step = jax.jit(g, static_argnums=(0,))
+            def run(x):
+                return step((1, 2), x)
+        """
+        assert not findings_for(src, "JL201")
+
+    def test_jl202_positive(self):
+        src = """
+            import jax
+            import numpy as np
+            WEIGHTS = np.ones(4)
+            @jax.jit
+            def f(x):
+                return x * WEIGHTS
+        """
+        assert findings_for(src, "JL202")
+
+    def test_jl202_negative_passed_as_argument(self):
+        src = """
+            import jax
+            import numpy as np
+            WEIGHTS = np.ones(4)
+            @jax.jit
+            def f(x, weights):
+                return x * weights
+            def call(x):
+                return f(x, WEIGHTS)
+        """
+        assert not findings_for(src, "JL202")
+
+    def test_jl203_positive(self):
+        src = """
+            def train_step(x, log):
+                for _ in range(2):
+                    log(f"input shape={x.shape}")
+                return x
+        """
+        assert findings_for(src, "JL203")
+
+    def test_jl203_negative_cold_function(self):
+        src = """
+            def describe(x):
+                return f"shape={x.shape}"
+        """
+        assert not findings_for(src, "JL203")
+
+
+# ---------------------------------------------------------------------------
+# JL301 donation
+# ---------------------------------------------------------------------------
+
+class TestDonationRule:
+    def test_jl301_positive(self):
+        src = """
+            import jax
+            class M:
+                def build(self):
+                    self._step = jax.jit(self._impl, donate_argnums=(0,))
+                def run(self, x):
+                    out = self._step(self.params, x)
+                    return self.params
+        """
+        assert findings_for(src, "JL301")
+
+    def test_jl301_negative_reassigned_first(self):
+        src = """
+            import jax
+            class M:
+                def build(self):
+                    self._step = jax.jit(self._impl, donate_argnums=(0,))
+                def run(self, x):
+                    out = self._step(self.params, x)
+                    self.params = out
+                    return self.params
+        """
+        assert not findings_for(src, "JL301")
+
+    def test_jl301_negative_multiline_call_args(self):
+        # the donating call's own (continuation-line) argument loads must
+        # not count as reads-after-donate
+        src = """
+            import jax
+            class M:
+                def build(self):
+                    self._step = jax.jit(self._impl, donate_argnums=(0, 1))
+                def run(self, x):
+                    out = self._step(
+                        self.params,
+                        self.opt_state, x)
+                    (self.params, self.opt_state) = out
+                    return out
+        """
+        assert not findings_for(src, "JL301")
+
+    def test_jl301_negative_across_exclusive_branches(self):
+        src = """
+            import jax
+            class M:
+                def build(self):
+                    self._step = jax.jit(self._impl, donate_argnums=(0,))
+                def run(self, x, fancy):
+                    if fancy:
+                        out = self._step(self.params, x)
+                        self._commit(out)
+                        return out
+                    out = self._step(self.params, x)
+                    self._commit(out)
+                    return out
+        """
+        assert not findings_for(src, "JL301")
+
+
+# ---------------------------------------------------------------------------
+# JL401 lock discipline
+# ---------------------------------------------------------------------------
+
+class TestLockRule:
+    def test_jl401_positive_unguarded(self):
+        src = """
+            import threading
+            class Worker:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0
+                def start(self):
+                    threading.Thread(target=self._run).start()
+                def _run(self):
+                    self.count += 1
+                def snapshot(self):
+                    return self.count
+        """
+        assert findings_for(src, "JL401")
+
+    def test_jl401_negative_guarded(self):
+        src = """
+            import threading
+            class Worker:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0
+                def start(self):
+                    threading.Thread(target=self._run).start()
+                def _run(self):
+                    with self._lock:
+                        self.count += 1
+                def snapshot(self):
+                    return self.count
+        """
+        assert not findings_for(src, "JL401")
+
+    def test_jl401_inconsistent_guards_flagged(self):
+        src = """
+            import threading
+            class Worker:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._other_lock = threading.Lock()
+                    self.count = 0
+                def start(self):
+                    threading.Thread(target=self._run).start()
+                def _run(self):
+                    with self._lock:
+                        self.count += 1
+                def bump(self):
+                    with self._other_lock:
+                        self.count += 1
+        """
+        assert findings_for(src, "JL401")
+
+    def test_jl401_atomic_annotation(self):
+        src = """
+            import threading
+            class Worker:
+                def start(self):
+                    threading.Thread(target=self._run).start()
+                def _run(self):
+                    self.done = True  # jaxlint: atomic
+                def poll(self):
+                    return self.done
+        """
+        assert not findings_for(src, "JL401")
+
+
+# ---------------------------------------------------------------------------
+# suppression comments
+# ---------------------------------------------------------------------------
+
+class TestSuppression:
+    def test_disable_single_rule(self):
+        src = """
+            def fit(model, data):
+                for b in data:
+                    s = float(model.score_value)  # jaxlint: disable=JL101
+                return s
+        """
+        assert not findings_for(src, "JL101")
+
+    def test_disable_all(self):
+        src = """
+            def fit(model, data):
+                for b in data:
+                    s = float(model.score_value)  # jaxlint: disable=all
+                return s
+        """
+        assert not findings_for(src)
+
+    def test_disable_other_rule_does_not_mask(self):
+        src = """
+            def fit(model, data):
+                for b in data:
+                    s = float(model.score_value)  # jaxlint: disable=JL999
+                return s
+        """
+        assert findings_for(src, "JL101")
+
+
+# ---------------------------------------------------------------------------
+# jit-boundary inference
+# ---------------------------------------------------------------------------
+
+LAZY_GETATTR_SRC = textwrap.dedent("""
+    import jax
+    from deeplearning4j_tpu.optimize import compile_cache as cc
+
+    def train_step(params, opt_state, rng, batch, flag):
+        return params, opt_state
+
+    def helper(params):
+        return params
+
+    class Net:
+        _TRAIN_JIT_ATTRS = ("_train_step_fn",)
+
+        def __getattr__(self, name):
+            if name in type(self)._TRAIN_JIT_ATTRS:
+                self._build_training_jits()
+                return object.__getattribute__(self, name)
+            raise AttributeError(name)
+
+        def _build_training_jits(self):
+            self._train_step_fn = cc.PrecompiledDispatch(
+                jax.jit(train_step, donate_argnums=(0, 1),
+                        static_argnums=(4,)), tag="train_step")
+""")
+
+
+class TestBoundaries:
+    def test_lazy_getattr_jit_builder(self):
+        tree = ast.parse(LAZY_GETATTR_SRC)
+        info = boundaries.infer(tree)
+        root_names = {getattr(n, "name", "") for n in info.roots}
+        assert "train_step" in root_names
+        assert len(info.assignments) == 1
+        asg = info.assignments[0]
+        assert asg.target_name == "_train_step_fn"
+        assert asg.is_self_attr
+        assert asg.fn_name == "train_step"
+        assert asg.donate_argnums == (0, 1)
+        assert asg.static_argnums == (4,)
+
+    def test_transitive_callee_reachable(self):
+        src = textwrap.dedent("""
+            import jax
+            def inner(x):
+                return x
+            @jax.jit
+            def outer(x):
+                return inner(x)
+        """)
+        info = boundaries.infer(ast.parse(src))
+        names = {getattr(n, "name", "") for n in info.reachable}
+        assert {"outer", "inner"} <= names
+
+    def test_lambda_and_scan_body_are_roots(self):
+        src = textwrap.dedent("""
+            import jax
+            def body(c, x):
+                return c, x
+            def run(xs):
+                return jax.lax.scan(body, 0, xs)
+            f = jax.jit(lambda x: x + 1)
+        """)
+        info = boundaries.infer(ast.parse(src))
+        assert any(isinstance(n, ast.Lambda) for n in info.roots)
+        names = {getattr(n, "name", "") for n in info.roots}
+        assert "body" in names
+
+    def test_alias_resolution(self):
+        src = "from jax import numpy as jnp\nimport time as _time\n"
+        aliases = boundaries.build_alias_map(ast.parse(src))
+        assert aliases["jnp"] == "jax.numpy"
+        assert aliases["_time"] == "time"
+
+
+# ---------------------------------------------------------------------------
+# baseline round-trip
+# ---------------------------------------------------------------------------
+
+HOT_SYNC_SRC = """
+    def fit(model, data):
+        for b in data:
+            s = float(model.score_value)
+        return s
+"""
+
+
+class TestBaseline:
+    def test_add_then_clean(self, tmp_path):
+        findings = findings_for(HOT_SYNC_SRC)
+        assert findings
+        bl = Baseline()
+        bl.record(findings, default_justification="known hot read")
+        path = tmp_path / "baseline.json"
+        bl.save(str(path))
+        loaded = Baseline.load(str(path))
+        result = loaded.match(findings_for(HOT_SYNC_SRC))
+        assert not result.new
+        assert len(result.known) == len(findings)
+        assert result.known[0].justification == "known hot read"
+        assert not result.expired
+
+    def test_expired_entry_reported(self, tmp_path):
+        findings = findings_for(HOT_SYNC_SRC)
+        bl = Baseline()
+        bl.record(findings)
+        # the offending line was fixed: nothing matches any more
+        result = bl.match([])
+        assert len(result.expired) == len(findings)
+        assert not result.new
+
+    def test_new_finding_not_masked(self):
+        bl = Baseline()
+        bl.record(findings_for(HOT_SYNC_SRC))
+        other = findings_for("""
+            def train(batches):
+                for b in batches:
+                    v = b.loss.item()
+                return v
+        """)
+        result = bl.match(other)
+        assert result.new == other
+
+    def test_multiset_semantics(self):
+        findings = findings_for(HOT_SYNC_SRC)
+        bl = Baseline()
+        bl.record(findings)
+        doubled = findings + findings_for(HOT_SYNC_SRC)
+        result = bl.match(doubled)
+        # one budget entry per recorded finding; the duplicate is NEW
+        assert len(result.new) == len(findings)
+
+    def test_record_preserves_justifications(self):
+        findings = findings_for(HOT_SYNC_SRC)
+        bl = Baseline()
+        bl.record(findings, default_justification="first pass")
+        bl.record(findings_for(HOT_SYNC_SRC))
+        assert bl.entries[0].justification == "first pass"
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+class TestCli:
+    def _write(self, tmp_path, body):
+        f = tmp_path / "mod.py"
+        f.write_text(textwrap.dedent(body))
+        return str(f)
+
+    def test_exit_zero_on_clean_file(self, tmp_path):
+        from deeplearning4j_tpu.analysis.cli import main
+        path = self._write(tmp_path, "def add(a, b):\n    return a + b\n")
+        assert main([path, "--no-baseline"]) == 0
+
+    def test_exit_one_on_findings_then_zero_after_baseline(self, tmp_path,
+                                                           capsys):
+        from deeplearning4j_tpu.analysis.cli import main
+        path = self._write(tmp_path, HOT_SYNC_SRC)
+        bl = str(tmp_path / "baseline.json")
+        assert main([path, "--baseline", bl]) == 1
+        assert main([path, "--baseline", bl, "--write-baseline"]) == 0
+        assert main([path, "--baseline", bl]) == 0
+        out = json.loads((tmp_path / "baseline.json").read_text())
+        assert out["entries"]
+
+    def test_json_format(self, tmp_path, capsys):
+        from deeplearning4j_tpu.analysis.cli import main
+        path = self._write(tmp_path, HOT_SYNC_SRC)
+        rc = main([path, "--no-baseline", "--format", "json"])
+        data = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert data["summary"]["new"] == len(data["new"]) >= 1
+
+    def test_unknown_rule_is_usage_error(self, tmp_path):
+        from deeplearning4j_tpu.analysis.cli import main
+        path = self._write(tmp_path, "x = 1\n")
+        assert main([path, "--rules", "JL999"]) == 2
+
+    def test_syntax_error_reported_not_crash(self, tmp_path):
+        from deeplearning4j_tpu.analysis.cli import main
+        path = self._write(tmp_path, "def broken(:\n")
+        assert main([path, "--no-baseline"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# tracecheck runtime shim
+# ---------------------------------------------------------------------------
+
+class TestTracecheck:
+    def test_float_on_jit_output_counts(self):
+        import jax.numpy as jnp
+        import jax
+        from deeplearning4j_tpu.analysis import tracecheck as tc
+        from deeplearning4j_tpu.optimize.metrics import registry
+        tc.reset_counts()
+        fam = registry().counter(
+            tc.METRIC_NAME,
+            "implicit device->host syncs observed by tracecheck")
+        before = fam.value(site="t_float")
+        out = tc.watch(jax.jit(lambda x: x * 2)(jnp.asarray(1.5)),
+                       site="t_float")
+        val = float(out)
+        assert val == 3.0
+        assert tc.sync_count("t_float") == 1
+        assert fam.value(site="t_float") == before + 1
+
+    def test_fenced_read_stays_flat(self):
+        import jax.numpy as jnp
+        import jax
+        from deeplearning4j_tpu.analysis import tracecheck as tc
+        tc.reset_counts()
+        out = tc.watch(jax.jit(lambda x: x + 1)(jnp.asarray(1.0)),
+                       site="t_fenced")
+        host = tc.fenced_read(out)
+        assert float(host) == 2.0
+        assert tc.sync_count("t_fenced") == 0
+
+    def test_item_and_asarray_count(self):
+        import numpy as np
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.analysis import tracecheck as tc
+        tc.reset_counts()
+        out = tc.watch(jnp.asarray([1.0, 2.0]), site="t_item")
+        _ = np.asarray(out)
+        _ = out.tolist()
+        assert tc.sync_count("t_item") == 2
+
+    def test_pytree_watch_and_passthrough(self):
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.analysis import tracecheck as tc
+        tc.reset_counts()
+        tree = tc.watch({"w": jnp.ones(2), "n": 3}, site="t_tree")
+        assert isinstance(tree["w"], tc.SyncSpy)
+        assert tree["n"] == 3
+        assert tuple(tree["w"].shape) == (2,)      # metadata: uncounted
+        assert (tree["w"] + 1).shape == (2,)       # arithmetic: uncounted
+        assert tc.sync_count("t_tree") == 0
+
+    def test_wrap_decorator(self):
+        import jax
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.analysis import tracecheck as tc
+        tc.reset_counts()
+        step = tc.wrap(jax.jit(lambda x: x * 3), site="t_wrap")
+        out = step(jnp.asarray(2.0))
+        assert isinstance(out, tc.SyncSpy)
+        assert int(out) == 6
+        assert tc.sync_count("t_wrap") == 1
+
+
+# ---------------------------------------------------------------------------
+# the shipped tree stays clean (duplicated as a smoke test in
+# tests/smoke_analysis.py for runtests.sh)
+# ---------------------------------------------------------------------------
+
+class TestShippedTree:
+    def test_package_clean_against_committed_baseline(self):
+        import os
+        from deeplearning4j_tpu.analysis.cli import main
+        pkg = os.path.dirname(os.path.dirname(os.path.abspath(
+            boundaries.__file__)))
+        assert main([pkg]) == 0
