@@ -536,6 +536,75 @@ def bench_lenet_hostfed(batch=2048, n_train=8192, epochs=2):
         shutil.rmtree(d, ignore_errors=True)
 
 
+def bench_serving(clients=8, requests_per_client=200, batch_limit=8):
+    """Serving gateway requests/sec (docs/serving.md): concurrent
+    clients with mixed 1-5 row payloads through the continuous-batching
+    gateway (in-process predict — the HTTP framing is stdlib, not the
+    subsystem under measure), after warmup() so the steady state rides
+    the AOT executables. Extras carry the latency percentiles, the shed
+    count (0 expected — no deadlines here), and the coalescing rate
+    (rows per forward) that continuous batching exists to maximize."""
+    import queue as _queue
+    import threading
+    from deeplearning4j_tpu import (Adam, DenseLayer, InputType,
+                                    MultiLayerNetwork,
+                                    NeuralNetConfiguration, OutputLayer,
+                                    WeightInit)
+    from deeplearning4j_tpu.serving import ServingGateway
+
+    conf = (NeuralNetConfiguration.builder().seed(42)
+            .updater(Adam(1e-3)).weight_init(WeightInit.XAVIER).list()
+            .layer(DenseLayer(n_out=256, activation="relu"))
+            .layer(DenseLayer(n_out=256, activation="relu"))
+            .layer(OutputLayer(n_out=10, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(64))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    gw = ServingGateway()
+    gw.add_model("default", net, batch_limit=batch_limit,
+                 queue_limit=1024)
+    gw.warmup()
+    rng = np.random.default_rng(0)
+    payloads = [rng.standard_normal((1 + (i % 5), 64)).astype(np.float32)
+                for i in range(16)]
+    errors: "_queue.Queue" = _queue.Queue()
+
+    def client(ci):
+        try:
+            for j in range(requests_per_client):
+                gw.predict("default", payloads[(ci + j) % len(payloads)])
+        except Exception as e:
+            errors.put(e)
+
+    # one unmeasured pass seeds the EWMA + any lazy route state
+    gw.predict("default", payloads[0])
+    t0 = time.perf_counter()
+    ts = [threading.Thread(target=client, args=(i,))
+          for i in range(clients)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    dt = time.perf_counter() - t0
+    if not errors.empty():
+        raise errors.get()
+    total = clients * requests_per_client
+    st = gw.stats()
+    entry = gw.pool.get("default")
+    forwards = max(1, entry.engine.total_forwards)
+    served_rows = sum(entry.engine.executed_batch_sizes)
+    gw.pool.shutdown()
+    lat = st["latency"].get("default", {})
+    return total / dt, {
+        "clients": clients,
+        "p50_ms": lat.get("p50_ms", 0.0),
+        "p99_ms": lat.get("p99_ms", 0.0),
+        "shed": entry.engine.total_shed,
+        "rows_per_forward": round(served_rows / forwards, 2),
+    }
+
+
 def _vs_baseline(metric, value):
     """Track best-so-far per metric in BENCH_baseline.json."""
     if "tiny" in metric:
@@ -641,6 +710,10 @@ def run_once(workload: str, arg):
     if workload == "etl":
         ips = bench_etl()
         return "host_image_etl_images_per_sec", ips, "images/sec", {}
+    if workload == "serving":
+        rps, ext = bench_serving()
+        return ("serving_gateway_requests_per_sec", rps, "requests/sec",
+                ext)
     if workload == "lenet_hostfed":
         ips, ext = bench_lenet_hostfed()
         return "lenet_mnist_hostfed_images_per_sec", ips, "images/sec", ext
@@ -659,7 +732,7 @@ def run_once(workload: str, arg):
         f"Unknown workload {workload!r}; use resnet50 [batch] | vgg16 | "
         "googlenet | attention | attention_longctx [seq] | alexnet | "
         "alexnet_pallaslrn | lenet | lenet_tiny | lstm | w2v [scale] | "
-        "etl | lenet_hostfed")
+        "etl | lenet_hostfed | serving")
 
 
 def main():

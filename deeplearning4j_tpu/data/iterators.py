@@ -415,13 +415,25 @@ class PadToBucketIterator(DataSetIterator):
     sum(mask) — the loss denominator — unchanged, so the math stays
     exact; synthesizing a time mask where none exists would flip the
     normalization semantics, so maskless ragged-time batches pass
-    through unpadded (shape change, honest recompile)."""
+    through unpadded (shape change, honest recompile).
 
-    def __init__(self, base, batch_size: Optional[int] = None):
+    `bucket_rows="pow2"` switches the row target from the first batch's
+    count to the shared power-of-two bucket rule
+    (data/padding.next_pow2_bucket — the same rounding ParallelInference
+    and the serving gateway use), for streams whose batch sizes vary
+    throughout rather than only at the tail: at most log2(max_batch)
+    distinct compiled shapes instead of one per distinct size."""
+
+    def __init__(self, base, batch_size: Optional[int] = None,
+                 bucket_rows: str = "first"):
+        if bucket_rows not in ("first", "pow2"):
+            raise ValueError(
+                f"bucket_rows must be 'first' or 'pow2', got {bucket_rows!r}")
         self._base = base
         self._fixed_target = batch_size
         self._target: Optional[int] = batch_size
         self._target_t: Optional[int] = None
+        self._bucket_rows = bucket_rows
         self._it: Optional[Iterator] = None
 
     def reset(self):
@@ -449,6 +461,14 @@ class PadToBucketIterator(DataSetIterator):
         return DataSet(pad_axis1(ds.features), pad_axis1(ds.labels),
                        pad_axis1(ds.features_mask), pad_axis1(ds.labels_mask))
 
+    def _row_target(self, n: int) -> int:
+        from .padding import next_pow2_bucket
+        if self._bucket_rows == "pow2" and self._fixed_target is None:
+            return next_pow2_bucket(n)
+        if self._target is None:
+            self._target = n
+        return self._target
+
     def __next__(self) -> DataSet:
         from .padding import (pad_dataset_rows, pad_lmask_zero_weight,
                               pad_multidataset_rows)
@@ -470,9 +490,8 @@ class PadToBucketIterator(DataSetIterator):
                     [m if m is not None
                      else pad_lmask_zero_weight(None, len(l), 0)
                      for m, l in zip(masks, ds.labels)])
-            if self._target is None:
-                self._target = ds.num_examples()
-            return pad_multidataset_rows(ds, self._target)
+            return pad_multidataset_rows(ds, self._row_target(
+                ds.num_examples()))
         if ds.labels_mask is None:
             ds = DataSet(ds.features, ds.labels, ds.features_mask,
                          pad_lmask_zero_weight(None, ds.num_examples(), 0))
@@ -486,9 +505,7 @@ class PadToBucketIterator(DataSetIterator):
                     and ds.labels_mask is not None \
                     and np.ndim(ds.labels_mask) >= 2:
                 ds = self._pad_time(ds, self._target_t)
-        if self._target is None:
-            self._target = ds.num_examples()
-        return pad_dataset_rows(ds, self._target)
+        return pad_dataset_rows(ds, self._row_target(ds.num_examples()))
 
     def batch_size(self):
         return self._base.batch_size() if hasattr(self._base, "batch_size") \

@@ -22,6 +22,17 @@ import numpy as np
 from .dataset import DataSet, MultiDataSet
 
 
+def next_pow2_bucket(n: int) -> int:
+    """Smallest power of two >= n: the canonical static-shape bucket.
+    Rounding every ragged row count to a pow2 bucket caps the number of
+    distinct XLA programs at log2(max_batch) — the one bucket rule the
+    pad-to-bucket iterator, ParallelInference, and the serving gateway
+    all share (so it cannot drift between training and serving)."""
+    if n < 1:
+        raise ValueError(f"bucket size needs n >= 1, got {n}")
+    return 1 << (int(n) - 1).bit_length()
+
+
 def repeat_tail_rows(a, pad: int):
     """Append `pad` copies of the last row (None-safe). Device-resident
     (jax) arrays pad with jnp ops so they never round-trip through host

@@ -4,7 +4,8 @@ Replaces the reference's entire deeplearning4j-scaleout tree (ParallelWrapper
 thread zoo, Spark parameter averaging, Aeron parameter server — SURVEY.md
 §2.4) with sharded jit over a jax.sharding.Mesh.
 """
-from .inference import InferenceMode, ParallelInference
+from .inference import (DeadlineExceededError, InferenceMode,
+                        ParallelInference, QueueFullError, ServerClosedError)
 from .multihost import CheckpointManager, MultiHostRunner
 from .mesh import (DATA_AXIS, MODEL_AXIS, SEQ_AXIS, batch_sharded,
                    create_mesh, data_parallel_mesh, replicate, replicated,

@@ -20,13 +20,16 @@ preserved, which is the observable semantic of the reference mode).
 from __future__ import annotations
 
 import collections
+import contextlib
 import enum
 import queue
 import threading
 import time
-from typing import List, Optional
+from typing import Callable, List, Optional
 
 import numpy as np
+
+from ..data.padding import next_pow2_bucket, repeat_tail_rows
 
 
 class InferenceMode(enum.Enum):
@@ -35,24 +38,42 @@ class InferenceMode(enum.Enum):
     BATCHED = "batched"
 
 
-class _Request:
-    __slots__ = ("x", "event", "result", "error")
+class ServerClosedError(RuntimeError):
+    """The server was shut down while (or before) this request was
+    queued — the caller gets this instead of hanging forever."""
 
-    def __init__(self, x: np.ndarray):
+
+class QueueFullError(RuntimeError):
+    """Admission queue at capacity: the backpressure signal (the
+    serving gateway maps this to a shed, not a 500)."""
+
+
+class DeadlineExceededError(RuntimeError):
+    """The request's deadline passed before a forward could serve it —
+    shed early rather than queued to death (Clipper-style SLO
+    awareness)."""
+
+
+class _Request:
+    __slots__ = ("x", "event", "result", "error", "deadline")
+
+    def __init__(self, x: np.ndarray, deadline: Optional[float] = None):
         self.x = x
         self.event = threading.Event()
         self.result: Optional[np.ndarray] = None
         self.error: Optional[BaseException] = None
+        # Absolute time.monotonic() seconds; None = no SLO.
+        self.deadline = deadline
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return self.deadline is not None and \
+            (now if now is not None else time.monotonic()) > self.deadline
 
 
-def _next_bucket(n: int) -> int:
-    """Smallest power of two >= n (static-shape buckets keep XLA from
-    recompiling per request mix — the TPU analog of the reference's
-    variable dynamic batch)."""
-    b = 1
-    while b < n:
-        b <<= 1
-    return b
+# Back-compat alias: the pow2 rounding now lives in data/padding.py so
+# the pad-to-bucket iterator, this engine, and the serving gateway share
+# ONE bucket rule.
+_next_bucket = next_pow2_bucket
 
 
 class ParallelInference:
@@ -78,6 +99,17 @@ class ParallelInference:
         # object lives for days) + a lifetime forward counter.
         self.executed_batch_sizes = collections.deque(maxlen=1024)
         self.total_forwards = 0
+        self.total_shed = 0
+        # EWMA of one coalesced forward's wall time (written under
+        # self._lock right after the forward it measures; the admission
+        # estimate reads it lock-free — a stale float is fine there).
+        self._ewma_batch_s = 0.0
+        # Buckets warmup() precompiled — the hot-swap warm set.
+        self.warmed_buckets: List[int] = []
+        # Gateway hooks: on_shed(request, reason) on every deadline drop;
+        # on_batch(requests, rows, bucket, dur_s) after every forward.
+        self.on_shed: Optional[Callable] = None
+        self.on_batch: Optional[Callable] = None
         if inference_mode == InferenceMode.BATCHED:
             self._worker = threading.Thread(
                 target=self._collector_loop, name="ParallelInference-collector",
@@ -102,42 +134,94 @@ class ParallelInference:
         `max_bucket` caps the sweep (default: the batch_limit bucket);
         `time_steps` sizes recurrent inputs (MultiLayerNetwork/
         ComputationGraph.precompile contract)."""
-        top = _next_bucket(max_bucket or self.batch_limit)
+        top = next_pow2_bucket(max_bucket or self.batch_limit)
         b = 1
         while b <= top:
             self.model.warmup(b, time_steps=time_steps)
+            if b not in self.warmed_buckets:
+                self.warmed_buckets.append(b)
             b <<= 1
         return self
 
+    # ------------------------------------------------------------ admission
+    def queue_depth(self) -> int:
+        """Requests currently queued (approximate — qsize races with the
+        collector by design; it is a gauge, not an invariant)."""
+        return self._queue.qsize()
+
+    def estimate_wait_s(self) -> float:
+        """Expected time until a request admitted NOW completes: queued
+        batches ahead of it plus its own forward, at the EWMA batch
+        time. 0.0 until the first forward seeds the EWMA (admit
+        optimistically while cold)."""
+        svc = self._ewma_batch_s
+        if svc <= 0.0:
+            return 0.0
+        batches_ahead = self.queue_depth() // max(1, self.batch_limit)
+        return (batches_ahead + 1) * svc
+
+    @contextlib.contextmanager
+    def paused(self):
+        """Hold the execution lock: the in-flight forward (if any)
+        completes, then dispatch stalls — queued requests WAIT, they are
+        not dropped or failed. The hot-swap window: ModelPool assigns
+        new params inside this context and traffic resumes against them
+        on exit."""
+        with self._lock:
+            yield self
+
     # ----------------------------------------------------------------- output
-    def output(self, x) -> np.ndarray:
+    def output(self, x, *, deadline: Optional[float] = None) -> np.ndarray:
         """Predict for one request (any leading batch size). Thread-safe;
         in BATCHED mode blocks until the coalesced forward containing this
-        request completes (reference output() → observable wait)."""
+        request completes (reference output() → observable wait).
+
+        `deadline` is an absolute time.monotonic() second count: a
+        request still unserved past it is failed with
+        :class:`DeadlineExceededError` instead of riding a forward it
+        can no longer use (the gateway's SLO shed contract). A full
+        admission queue raises :class:`QueueFullError` (backpressure),
+        a closed server :class:`ServerClosedError`."""
         x = np.asarray(x)
         if x.ndim == 0:
             raise ValueError("Request must have a leading batch dimension")
         if self.inference_mode == InferenceMode.SEQUENTIAL:
             if self._shutdown:
-                raise RuntimeError("ParallelInference has been shut down")
+                raise ServerClosedError(
+                    "ParallelInference has been shut down")
             with self._lock:
+                req = _Request(x, deadline)
+                if req.expired():
+                    self._shed(req, "expired")
+                    raise DeadlineExceededError(
+                        "deadline passed before dispatch")
                 return self._forward(x)
-        req = _Request(x)
+        req = _Request(x, deadline)
         # Enqueue under the same lock shutdown() uses to place its sentinel,
         # so no request can ever land BEHIND the sentinel and starve.
         with self._enqueue_lock:
             if self._shutdown:
-                raise RuntimeError("ParallelInference has been shut down")
+                raise ServerClosedError(
+                    "ParallelInference has been shut down")
             try:
                 self._queue.put_nowait(req)
             except queue.Full:
-                raise RuntimeError(
+                raise QueueFullError(
                     f"ParallelInference queue limit ({self._queue.maxsize}) "
                     "exceeded — server overloaded") from None
         req.event.wait()
         if req.error is not None:
             raise req.error
         return req.result
+
+    def _shed(self, req: _Request, reason: str) -> None:
+        self.total_shed += 1
+        cb = self.on_shed
+        if cb is not None:
+            try:
+                cb(req, reason)
+            except Exception:
+                pass  # a broken hook must never take the server down
 
     def _forward(self, x: np.ndarray) -> np.ndarray:
         return self.model.output(x)
@@ -163,13 +247,26 @@ class ParallelInference:
             raise
 
     def _collect(self):
+        # The bucket ceiling warmup() precompiled to. Coalescing must
+        # never assemble a batch past it: rows that overshoot would
+        # round to an UNWARMED pow2 bucket and trigger a steady-state
+        # XLA compile (the exact thing warmup exists to prevent). A
+        # request that would overflow is carried to the next batch
+        # instead. (A single request larger than the ceiling still runs
+        # alone and pays its honest compile — that is the client's
+        # batch, not a coalescing artifact.)
+        cap = next_pow2_bucket(self.batch_limit)
+        carry: Optional[_Request] = None
         while True:
-            try:
-                first = self._queue.get(timeout=0.1)
-            except queue.Empty:
-                if self._shutdown:
-                    return
-                continue
+            if carry is not None:
+                first, carry = carry, None
+            else:
+                try:
+                    first = self._queue.get(timeout=0.1)
+                except queue.Empty:
+                    if self._shutdown:
+                        return
+                    continue
             if first is None:  # shutdown sentinel: serve stragglers, exit
                 self._drain_and_exit()
                 return
@@ -189,17 +286,21 @@ class ParallelInference:
                 if nxt is None:
                     saw_sentinel = True
                     break
+                if rows + nxt.x.shape[0] > cap:
+                    carry = nxt  # would overflow the warmed bucket set
+                    break
                 batch.append(nxt)
                 rows += nxt.x.shape[0]
             self._run_batch(batch)
             if saw_sentinel:
-                self._drain_and_exit()
+                self._drain_and_exit(carry)
                 return
 
-    def _drain_and_exit(self):
+    def _drain_and_exit(self, carry: Optional[_Request] = None):
         """Serve every request still queued at shutdown (none can arrive
-        after the sentinel — enqueue holds the same lock)."""
-        leftovers = []
+        after the sentinel — enqueue holds the same lock), in cap-sized
+        batches so even the shutdown flush stays on warmed buckets."""
+        leftovers = [] if carry is None else [carry]
         while True:
             try:
                 r = self._queue.get_nowait()
@@ -207,21 +308,61 @@ class ParallelInference:
                 break
             if r is not None:
                 leftovers.append(r)
-        if leftovers:
-            self._run_batch(leftovers)
+        cap = next_pow2_bucket(self.batch_limit)
+        batch: List[_Request] = []
+        rows = 0
+        for r in leftovers:
+            if batch and rows + r.x.shape[0] > cap:
+                self._run_batch(batch)
+                batch, rows = [], 0
+            batch.append(r)
+            rows += r.x.shape[0]
+        if batch:
+            self._run_batch(batch)
 
     def _run_batch(self, batch: List[_Request]):
+        # SLO late-shed: a request whose deadline passed while queued
+        # cannot make its SLO — fail it NOW rather than spend forward
+        # rows on an answer nobody is waiting for.
+        now = time.monotonic()
+        live = []
+        for r in batch:
+            if r.expired(now):
+                self._shed(r, "expired")
+                r.error = DeadlineExceededError(
+                    "deadline passed while queued")
+                r.event.set()
+            else:
+                live.append(r)
+        batch = live
+        if not batch:
+            return
         try:
             xs = np.concatenate([r.x for r in batch], axis=0)
             n = xs.shape[0]
-            bucket = _next_bucket(n)
-            if bucket > n:
-                pad = np.repeat(xs[-1:], bucket - n, axis=0)
-                xs = np.concatenate([xs, pad], axis=0)
+            bucket = next_pow2_bucket(n)
+            # Pad to the bucket under the shared repeat-tail contract
+            # (data/padding.py) — same rule as the fit pipeline, no loss
+            # mask needed on the inference path (pad rows are sliced off
+            # before any caller sees them).
+            xs = repeat_tail_rows(xs, bucket - n)
+            t0 = time.perf_counter()
             with self._lock:
                 out = self._forward(xs)
+                dur = time.perf_counter() - t0
+                # EWMA seeds on the first forward, then smooths at 0.2 —
+                # reactive enough for the admission estimate, stable
+                # enough not to flap on one slow batch.
+                self._ewma_batch_s = dur if self._ewma_batch_s <= 0.0 \
+                    else 0.8 * self._ewma_batch_s + 0.2 * dur
             self.executed_batch_sizes.append(n)
             self.total_forwards += 1
+            cb = self.on_batch
+            if cb is not None:
+                try:
+                    cb(batch, n, bucket, dur)
+                except Exception:
+                    pass  # a broken hook must never take the server down
             ofs = 0
             for r in batch:
                 k = r.x.shape[0]
@@ -240,17 +381,42 @@ class ParallelInference:
                 self._run_batch([r])
 
     # --------------------------------------------------------------- shutdown
-    def shutdown(self):
+    def _fail_pending(self, exc: BaseException) -> None:
+        """Fail every request still queued so no caller is stranded
+        blocking on its event (satellite fix: a dead or wedged collector
+        used to leave them waiting forever)."""
+        while True:
+            try:
+                r = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            if r is not None:
+                r.error = exc
+                r.event.set()
+
+    def shutdown(self, join_timeout: float = 5.0):
+        """Close the server: stragglers already queued are SERVED by the
+        collector's drain pass; anything it could not serve within the
+        join window (collector dead, forward wedged) is failed with
+        :class:`ServerClosedError` instead of hanging its caller."""
+        already = False
         with self._enqueue_lock:
             if self._shutdown:
-                return
-            self._shutdown = True
-            if self._worker is not None:
-                # May briefly block if the queue is full; the collector
-                # keeps draining without this lock, so it always frees up.
-                self._queue.put(None)
-        if self._worker is not None:
-            self._worker.join(timeout=5)
+                already = True
+            else:
+                self._shutdown = True
+                if self._worker is not None:
+                    # May briefly block if the queue is full; the collector
+                    # keeps draining without this lock, so it always frees
+                    # up.
+                    self._queue.put(None)
+        if self._worker is not None and not already:
+            self._worker.join(timeout=join_timeout)
+        # After the join window nothing will ever serve these — and on a
+        # REPEAT shutdown() the sweep is how callers stranded by a first
+        # failed close get released.
+        self._fail_pending(ServerClosedError(
+            "ParallelInference was shut down before this request ran"))
 
     def __enter__(self):
         return self

@@ -1,0 +1,250 @@
+"""Serving gateway: admission, continuous batching, SLO shedding,
+per-model routing, and checkpoint-gated hot-swap over one HTTP surface.
+
+The request lifecycle (docs/serving.md):
+
+    POST /predict ──► route (ModelPool) ──► ADMISSION
+        │  deadline hopeless (EWMA wait estimate) ──► SHED 503
+        │  queue full ──────────────────────────────► SHED 429
+        ▼
+    continuous-batching engine (ParallelInference): concurrent
+    requests coalesce into ONE forward padded to the shared pow2
+    bucket (data/padding.next_pow2_bucket), served by the AOT
+    executables warmup() built — steady state compiles NOTHING.
+        │  deadline passed while queued ──► SHED 503 (late)
+        ▼
+    row slices scattered back ──► 200 {"predictions", "model",
+                                       "version", "latency_ms"}
+
+Adapted from continuous batching (Orca, OSDI '22 — requests join the
+next forward, no epoch barriers) and SLO-aware adaptive shedding
+(Clipper, NSDI '17 — reject early what cannot make its deadline),
+re-shaped for the static-shape XLA world: the batch axis quantizes to
+power-of-two buckets so the executable set is finite and precompiled.
+
+Endpoints: POST /predict, POST /swap, GET /health, GET /models,
+GET /stats, GET /metrics (Prometheus exposition — scrape surface shared
+with UIServer, docs/observability.md). Metrics:
+`serving_requests_total{model,status}`, `serving_admitted_total`,
+`serving_shed_total{model,reason}`, `serving_swaps_total{model,outcome}`,
+`serving_queue_depth{model}`, `serving_latency_ms{model}` histogram plus
+scrape-time `serving_latency_p50_ms`/`serving_latency_p99_ms` gauges.
+Every request runs inside a `serve/request` tracing span.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..optimize import tracing
+from ..optimize.metrics import registry
+from ..parallel.inference import (DeadlineExceededError, QueueFullError,
+                                  ServerClosedError)
+from ..utils.http_server import JsonHttpServer
+from .model_pool import ModelPool, SwapError
+
+__all__ = ["ServingGateway"]
+
+# Latency histogram buckets in ms — sub-ms to 10 s covers an AOT CPU
+# forward through a tunneled-TPU worst case.
+LATENCY_BUCKETS_MS = (0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
+                      250.0, 500.0, 1000.0, 2500.0, 10000.0)
+
+
+def _percentile(sorted_vals, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return float(sorted_vals[idx])
+
+
+class ServingGateway(JsonHttpServer):
+    """HTTP + in-process serving facade over a ModelPool.
+
+    `default_deadline_ms` applies to requests that carry no deadline
+    (None = no SLO, never shed on time). `shed_headroom` scales the
+    admission wait estimate (>1.0 sheds earlier, trading recall of the
+    SLO for fewer wasted queue slots)."""
+
+    def __init__(self, pool: Optional[ModelPool] = None, *, port: int = 0,
+                 pool_size: int = 8,
+                 default_deadline_ms: Optional[float] = None,
+                 shed_headroom: float = 1.0):
+        super().__init__(
+            get_routes={"/health": self._health_route,
+                        "/models": self._models_route,
+                        "/stats": self._stats_route},
+            post_routes={"/predict": self._predict_route,
+                         "/swap": self._swap_route},
+            port=port, pool_size=pool_size, expose_metrics=True)
+        self.pool = pool if pool is not None else ModelPool()
+        self.default_deadline_ms = default_deadline_ms
+        self.shed_headroom = float(shed_headroom)
+        self._lat_lock = threading.Lock()
+        # Recent per-model latencies for p50/p99 (bounded: a gateway
+        # lives for days) — the registry histogram is the durable record.
+        self._latencies: Dict[str, collections.deque] = {}
+        reg = registry()
+        self._req_c = reg.counter(
+            "serving_requests_total",
+            "Gateway requests by terminal status (ok/shed/error)")
+        self._admit_c = reg.counter(
+            "serving_admitted_total",
+            "Requests admitted past SLO/backpressure checks")
+        self._shed_c = reg.counter(
+            "serving_shed_total",
+            "Requests shed before a forward served them, by reason")
+        self._lat_h = reg.histogram(
+            "serving_latency_ms",
+            "End-to-end request latency through the gateway",
+            buckets=LATENCY_BUCKETS_MS)
+        reg.register_collector(self._collect_percentiles)
+
+    # ------------------------------------------------------------ model mgmt
+    def add_model(self, name: str, model, **kw):
+        """pool.add passthrough (see ModelPool.add for knobs)."""
+        return self.pool.add(name, model, **kw)
+
+    def warmup(self, name: Optional[str] = None, **kw) -> "ServingGateway":
+        self.pool.warmup(name, **kw)
+        return self
+
+    def swap(self, name: str, **kw) -> Dict[str, Any]:
+        """Checkpoint-gated hot-swap (ModelPool.swap protocol)."""
+        return self.pool.swap(name, **kw)
+
+    # -------------------------------------------------------------- predict
+    def predict(self, name: str, x, *,
+                deadline_ms: Optional[float] = None) -> np.ndarray:
+        """In-process entry point (the HTTP route is a thin wrapper).
+        Raises DeadlineExceededError / QueueFullError on shed,
+        KeyError on unknown model."""
+        # Unknown model: plain KeyError, no metrics — client-supplied
+        # junk names must not mint unbounded label cardinality.
+        entry = self.pool.get(name)
+        t0 = time.perf_counter()
+        status = "error"
+        try:
+            if deadline_ms is None:
+                deadline_ms = self.default_deadline_ms
+            deadline = None if deadline_ms is None else \
+                time.monotonic() + float(deadline_ms) / 1000.0
+            with tracing.span("serve/request", model=name):
+                if deadline is not None:
+                    # SLO-aware admission: estimated completion past the
+                    # deadline means this request can only waste a queue
+                    # slot — shed it NOW with a distinct status.
+                    est = entry.engine.estimate_wait_s() * self.shed_headroom
+                    if time.monotonic() + est > deadline:
+                        self._shed_c.labels(model=name,
+                                            reason="admission").inc()
+                        status = "shed"
+                        raise DeadlineExceededError(
+                            f"estimated wait {est * 1000:.1f}ms cannot "
+                            f"meet deadline {deadline_ms}ms — shed at "
+                            "admission")
+                self._admit_c.labels(model=name).inc()
+                try:
+                    out = entry.engine.output(x, deadline=deadline)
+                except QueueFullError:
+                    self._shed_c.labels(model=name,
+                                        reason="queue_full").inc()
+                    status = "shed"
+                    raise
+                except DeadlineExceededError:
+                    # late shed: counted by the engine's on_shed hook
+                    # (reason="expired") — only the status lands here.
+                    status = "shed"
+                    raise
+            status = "ok"
+            return out
+        finally:
+            dur_ms = (time.perf_counter() - t0) * 1000.0
+            self._req_c.labels(model=name, status=status).inc()
+            self._lat_h.labels(model=name).observe(dur_ms)
+            if status == "ok":
+                with self._lat_lock:
+                    dq = self._latencies.get(name)
+                    if dq is None:
+                        dq = self._latencies.setdefault(
+                            name, collections.deque(maxlen=2048))
+                    dq.append(dur_ms)
+
+    # ---------------------------------------------------------------- stats
+    def stats(self) -> Dict[str, Any]:
+        """Per-model {p50_ms, p99_ms, count} over the recent-latency
+        window plus the pool description (bench.py's serving row reads
+        this)."""
+        out: Dict[str, Any] = {"models": self.pool.describe()}
+        lat: Dict[str, Any] = {}
+        with self._lat_lock:
+            items = [(n, sorted(d)) for n, d in self._latencies.items()]
+        for name, vals in items:
+            lat[name] = {"p50_ms": round(_percentile(vals, 0.50), 3),
+                         "p99_ms": round(_percentile(vals, 0.99), 3),
+                         "count": len(vals)}
+        out["latency"] = lat
+        return out
+
+    def _collect_percentiles(self, reg) -> None:
+        g50 = reg.gauge("serving_latency_p50_ms",
+                        "p50 gateway latency over the recent window")
+        g99 = reg.gauge("serving_latency_p99_ms",
+                        "p99 gateway latency over the recent window")
+        with self._lat_lock:
+            items = [(n, sorted(d)) for n, d in self._latencies.items()]
+        for name, vals in items:
+            g50.labels(model=name).set(_percentile(vals, 0.50))
+            g99.labels(model=name).set(_percentile(vals, 0.99))
+
+    # ------------------------------------------------------------ lifecycle
+    def stop(self):
+        """Graceful: finish in-flight HTTP handlers (JsonHttpServer),
+        then drain the engines (stragglers served, stranded callers
+        failed with ServerClosedError — never hung)."""
+        super().stop()
+        self.pool.shutdown()
+
+    # --------------------------------------------------------------- routes
+    def _health_route(self, _):
+        return 200, {"status": "ok", "models": sorted(self.pool.names())}
+
+    def _models_route(self, _):
+        return 200, {"models": self.pool.describe()}
+
+    def _stats_route(self, _):
+        return 200, self.stats()
+
+    def _predict_route(self, req: dict):
+        name = req.get("model", "default")
+        x = np.asarray(req["features"], np.float32)
+        deadline_ms = req.get("deadline_ms")
+        try:
+            out = self.predict(name, x, deadline_ms=deadline_ms)
+        except KeyError as e:
+            return 404, {"status": "error", "error": str(e)}
+        except QueueFullError as e:
+            return 429, {"status": "shed", "reason": "queue_full",
+                         "error": str(e)}
+        except DeadlineExceededError as e:
+            return 503, {"status": "shed", "reason": "deadline",
+                         "error": str(e)}
+        except ServerClosedError as e:
+            return 503, {"status": "error", "error": str(e)}
+        entry = self.pool.get(name)
+        return 200, {"status": "ok", "model": name,
+                     "version": entry.version.get("file", "initial"),
+                     "predictions": np.asarray(out).tolist()}
+
+    def _swap_route(self, req: dict):
+        name = req.get("model", "default")
+        try:
+            return 200, self.swap(name)
+        except KeyError as e:
+            return 404, {"status": "error", "error": str(e)}
+        except SwapError as e:
+            return 409, {"status": "swap_failed", "error": str(e)}

@@ -24,11 +24,11 @@ from ..utils.http_server import JsonHttpServer
 
 
 class KerasBackendServer(JsonHttpServer):
-    def __init__(self, port: int = 0):
+    def __init__(self, port: int = 0, pool_size: int = 8):
         super().__init__(
             get_routes={"/health": self._health},
             post_routes={"/fit": self._fit, "/predict": self._predict},
-            port=port)
+            port=port, pool_size=pool_size, expose_metrics=True)
         self._models: Dict[str, object] = {}
         self._lock = threading.Lock()
         self._next_id = 0

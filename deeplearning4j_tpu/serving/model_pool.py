@@ -1,0 +1,282 @@
+"""Named model pool with checkpoint-gated zero-downtime hot-swap.
+
+Each entry pairs a live network with its continuous-batching execution
+engine (parallel/inference.ParallelInference) and, optionally, the
+CheckpointManager a training run publishes to. The pool is the
+gateway's routing table (docs/serving.md) and the owner of the swap
+protocol:
+
+1. **Gate** — `CheckpointManager.latest_valid()` picks the newest
+   checkpoint whose sha256 manifest entry verifies; torn/corrupt
+   publishes are skipped, an empty manifest refuses the swap.
+2. **Decode off the hot path** — params/state npz trees are read and
+   device-staged against the LIVE model's trees as templates (same
+   treedef, same shapes — an architecture mismatch fails here, before
+   traffic is touched), while the engine keeps serving.
+3. **Pause–assign–warm** — the engine's execution lock is held just
+   long enough to assign the new trees and push one zero batch per
+   warmed bucket through the EXISTING AOT executables (shapes are
+   unchanged, so this re-verifies the fast path with the new params and
+   compiles nothing). In-flight requests finish first; queued requests
+   WAIT — none are dropped or failed.
+4. **Rollback on failure** — if the warm forward raises, the old trees
+   are restored before the lock is released and the swap reports
+   failed; traffic never sees half-swapped params.
+
+Swap outcomes land in `serving_swaps_total{model,outcome}`; per-model
+queue depth is sampled into `serving_queue_depth{model}` at scrape
+time.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import weakref
+import zipfile
+from typing import Any, Dict, List, Optional
+
+from ..optimize import tracing
+from ..optimize.metrics import registry
+from ..parallel.inference import InferenceMode, ParallelInference
+from ..utils.model_serializer import (PARAMS_ENTRY, STATE_ENTRY,
+                                      CheckpointCorruptError,
+                                      _npz_bytes_to_tree, _read_entry,
+                                      validate_checkpoint)
+
+__all__ = ["ModelEntry", "ModelPool", "SwapError"]
+
+
+class SwapError(RuntimeError):
+    """Hot-swap refused: no CheckpointManager attached, no valid
+    checkpoint published, architecture mismatch, or the warm forward
+    failed (in which case the old params were rolled back and are still
+    serving)."""
+
+
+def _swap_counter(name: str, outcome: str):
+    registry().counter(
+        "serving_swaps_total",
+        "Checkpoint hot-swap attempts by outcome (ok/noop/failed)"
+        ).labels(model=name, outcome=outcome).inc()
+
+
+class ModelEntry:
+    """One named served model: the live network, its batching engine,
+    and the checkpoint source it hot-swaps from."""
+
+    def __init__(self, name: str, model, engine: ParallelInference,
+                 checkpoints=None):
+        self.name = name
+        self.model = model
+        self.engine = engine
+        self.checkpoints = checkpoints
+        # Manifest record of the checkpoint currently serving; empty
+        # until the first swap (initial params came from the caller,
+        # not a published checkpoint).
+        self.version: Dict[str, Any] = {}
+        self.swaps = 0
+
+    def describe(self) -> Dict[str, Any]:
+        return {
+            "model": self.name,
+            "version": self.version.get("file", "initial"),
+            "iteration": int(getattr(self.model, "iteration", 0)),
+            "swaps": self.swaps,
+            "queue_depth": self.engine.queue_depth(),
+            "warmed_buckets": list(self.engine.warmed_buckets),
+            "total_forwards": self.engine.total_forwards,
+            "total_shed": self.engine.total_shed,
+        }
+
+
+class ModelPool:
+    """Thread-safe name → ModelEntry routing table + swap protocol."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: Dict[str, ModelEntry] = {}
+        # Weakly-referenced scrape collector: queue depth is sampled at
+        # scrape time only (never in the request path), and a dead pool
+        # silently drops out of the scrape.
+        wr = weakref.ref(self)
+
+        def _collect(reg, _wr=wr):
+            pool = _wr()
+            if pool is None:
+                return
+            g = reg.gauge("serving_queue_depth",
+                          "Requests queued per served model")
+            for e in pool.entries():
+                g.labels(model=e.name).set(e.engine.queue_depth())
+
+        registry().register_collector(_collect)
+
+    # ------------------------------------------------------------- routing
+    def add(self, name: str, model, *, checkpoints=None,
+            batch_limit: int = 32, queue_limit: int = 256,
+            batch_timeout_ms: float = 2.0,
+            inference_mode: InferenceMode = InferenceMode.BATCHED
+            ) -> ModelEntry:
+        """Register an init()ed model under `name` behind a fresh
+        continuous-batching engine. `checkpoints` (a CheckpointManager
+        or a directory path) enables hot-swap for this entry."""
+        if isinstance(checkpoints, (str, os.PathLike)):
+            from ..optimize.resilience import CheckpointManager
+            checkpoints = CheckpointManager(checkpoints)
+        engine = ParallelInference(
+            model, inference_mode=inference_mode, batch_limit=batch_limit,
+            queue_limit=queue_limit, batch_timeout_ms=batch_timeout_ms)
+        entry = ModelEntry(name, model, engine, checkpoints)
+        # Engine-level telemetry hooks: late (in-queue) deadline sheds
+        # and per-forward batch stats, labeled by model.
+        reg = registry()
+        shed_c = reg.counter(
+            "serving_shed_total",
+            "Requests shed before a forward served them, by reason")
+        fwd_c = reg.counter("serving_forwards_total",
+                            "Coalesced forward passes executed")
+        rows_c = reg.counter("serving_rows_total",
+                             "Real (un-padded) request rows served")
+        fill_h = reg.histogram(
+            "serving_batch_rows",
+            "Real rows per coalesced forward (bucket fill)")
+
+        def _on_shed(req, reason, _name=name):
+            shed_c.labels(model=_name, reason=reason).inc()
+
+        def _on_batch(reqs, rows, bucket, dur_s, _name=name):
+            fwd_c.labels(model=_name).inc()
+            rows_c.labels(model=_name).inc(rows)
+            fill_h.labels(model=_name).observe(rows)
+
+        engine.on_shed = _on_shed
+        engine.on_batch = _on_batch
+        with self._lock:
+            if name in self._entries:
+                raise ValueError(f"model {name!r} already registered")
+            self._entries[name] = entry
+        return entry
+
+    def get(self, name: str) -> ModelEntry:
+        with self._lock:
+            entry = self._entries.get(name)
+        if entry is None:
+            raise KeyError(f"no model named {name!r} in the pool "
+                           f"(have: {sorted(self.names())})")
+        return entry
+
+    def remove(self, name: str) -> None:
+        with self._lock:
+            entry = self._entries.pop(name, None)
+        if entry is not None:
+            entry.engine.shutdown()
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return list(self._entries)
+
+    def entries(self) -> List[ModelEntry]:
+        with self._lock:
+            return list(self._entries.values())
+
+    def describe(self) -> List[Dict[str, Any]]:
+        return [e.describe() for e in self.entries()]
+
+    # -------------------------------------------------------------- warmup
+    def warmup(self, name: Optional[str] = None, *,
+               max_bucket: Optional[int] = None,
+               time_steps: Optional[int] = None) -> "ModelPool":
+        """AOT-precompile every pow2 bucket for one model (or all):
+        after this, steady-state serving never compiles."""
+        targets = [self.get(name)] if name else self.entries()
+        for e in targets:
+            e.engine.warmup(max_bucket=max_bucket, time_steps=time_steps)
+        return self
+
+    # ---------------------------------------------------------------- swap
+    def swap(self, name: str, *, manager=None,
+             time_steps: Optional[int] = None) -> Dict[str, Any]:
+        """Checkpoint-gated zero-downtime hot-swap (module docstring
+        protocol). Returns {"swapped": bool, "model", "file",
+        "iteration"}; raises :class:`SwapError` when the gate or the
+        warm fails (old params keep serving either way)."""
+        entry = self.get(name)
+        mgr = manager or entry.checkpoints
+        if mgr is None:
+            _swap_counter(name, "failed")
+            raise SwapError(f"model {name!r} has no CheckpointManager "
+                            "attached — nothing to swap from")
+        rec = mgr.latest_valid()
+        if rec is None:
+            _swap_counter(name, "failed")
+            raise SwapError(
+                f"no valid checkpoint in {mgr.directory!r} — manifest "
+                "empty or every entry torn/corrupt")
+        if rec.get("file") and rec.get("file") == entry.version.get("file"):
+            _swap_counter(name, "noop")
+            return {"swapped": False, "model": name, "file": rec["file"],
+                    "iteration": rec.get("iteration", 0),
+                    "reason": "already serving this checkpoint"}
+        path = os.path.join(mgr.directory, rec["file"])
+        model = entry.model
+        with tracing.span("serve/swap", model=name, file=rec.get("file")):
+            # Decode + device-stage OUTSIDE the execution lock: traffic
+            # keeps flowing while the npz trees are read. The live trees
+            # are the templates, so a config/architecture drift fails
+            # here — before anything was mutated.
+            try:
+                meta = validate_checkpoint(path)
+                with zipfile.ZipFile(path, "r") as zf:
+                    new_params = _npz_bytes_to_tree(
+                        _read_entry(zf, path, PARAMS_ENTRY),
+                        model.params_tree)
+                    new_state = _npz_bytes_to_tree(
+                        _read_entry(zf, path, STATE_ENTRY),
+                        model.state_tree)
+            except (CheckpointCorruptError, ValueError) as e:
+                _swap_counter(name, "failed")
+                raise SwapError(
+                    f"checkpoint {rec.get('file')!r} cannot serve model "
+                    f"{name!r}: {e}") from e
+            old = (model.params_tree, model.state_tree,
+                   int(model.iteration), int(model.epoch))
+            buckets = list(entry.engine.warmed_buckets) or [1]
+            with entry.engine.paused():
+                model.params_tree = new_params
+                model.state_tree = new_state
+                model.iteration = int(meta.get("iteration", old[2]))
+                model.epoch = int(meta.get("epoch", old[3]))
+                if hasattr(model, "_rnn_carry"):
+                    model._rnn_carry = None
+                try:
+                    # Warm the new params through the EXISTING AOT
+                    # executables (warmup() re-precompile is a no-op per
+                    # stored signature: zero compile events).
+                    for b in buckets:
+                        model.warmup(b, time_steps=time_steps)
+                except Exception as e:
+                    (model.params_tree, model.state_tree,
+                     model.iteration, model.epoch) = old
+                    if hasattr(model, "_rnn_carry"):
+                        model._rnn_carry = None
+                    _swap_counter(name, "failed")
+                    raise SwapError(
+                        f"warm forward failed on {rec.get('file')!r}; "
+                        f"rolled back to previous params: {e}") from e
+        with self._lock:
+            entry.version = dict(rec)
+            entry.swaps += 1
+        _swap_counter(name, "ok")
+        return {"swapped": True, "model": name, "file": rec.get("file"),
+                "iteration": rec.get("iteration", 0)}
+
+    # ------------------------------------------------------------ lifecycle
+    def shutdown(self) -> None:
+        for e in self.entries():
+            e.engine.shutdown()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()

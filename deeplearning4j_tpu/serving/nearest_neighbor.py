@@ -58,9 +58,10 @@ class NearestNeighborsServer(JsonHttpServer):
     """
 
     def __init__(self, points, port: int = 0, metric: str = "euclidean",
-                 use_device: bool = True):
+                 use_device: bool = True, pool_size: int = 8):
         super().__init__(get_routes={"/health": self._health},
-                         post_routes={"/knn": self._knn}, port=port)
+                         post_routes={"/knn": self._knn}, port=port,
+                         pool_size=pool_size, expose_metrics=True)
         self.nn = NearestNeighbor(points, metric=metric,
                                   use_device=use_device)
 

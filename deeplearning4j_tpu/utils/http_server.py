@@ -1,15 +1,50 @@
 """Shared stdlib JSON-HTTP server scaffolding for the serving facades
-(k-NN server, Keras backend server, remote stats receiver) — one place
-for handler/json/start/stop/context-manager mechanics."""
+(serving gateway, k-NN server, Keras backend server, remote stats
+receiver) — one place for handler/json/start/stop/context-manager
+mechanics.
+
+Serving-grade hardening (docs/serving.md): requests are handled on a
+BOUNDED thread pool (`pool_size` concurrent handlers — unbounded
+thread-per-request falls over exactly when a gateway is overloaded,
+which is when it matters), `stop()` is graceful (close the listening
+socket so no new connection is accepted, then finish every in-flight
+handler before returning), and any server can expose the process-global
+metrics registry at ``GET /metrics`` with `expose_metrics=True` (the
+Prometheus scrape surface, same exposition as the UIServer's).
+"""
 from __future__ import annotations
 
 import json
 import threading
+from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable, Dict, Optional, Tuple
+from typing import Callable, Dict, Optional
 
 # route tables: {path: handler(request_dict_or_None) -> (code, obj)}
 Routes = Dict[str, Callable]
+
+
+class _PooledHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer whose per-connection work runs on a bounded
+    ThreadPoolExecutor instead of an unbounded thread-per-request."""
+
+    def __init__(self, addr, handler_cls, pool_size: int):
+        super().__init__(addr, handler_cls)
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(1, int(pool_size)),
+            thread_name_prefix="JsonHttpServer")
+
+    def process_request(self, request, client_address):
+        try:
+            self._pool.submit(self.process_request_thread, request,
+                              client_address)
+        except RuntimeError:  # pool already shut down: closing race
+            self.shutdown_request(request)
+
+    def close_pool(self):
+        # wait=True: every in-flight handler finishes before stop()
+        # returns — the graceful half of graceful shutdown.
+        self._pool.shutdown(wait=True)
 
 
 class JsonHttpServer:
@@ -18,15 +53,19 @@ class JsonHttpServer:
 
     def __init__(self, get_routes: Routes, post_routes: Routes,
                  port: int = 0, host: str = "127.0.0.1",
-                 raw_get_routes: Optional[Routes] = None):
+                 raw_get_routes: Optional[Routes] = None,
+                 pool_size: int = 8, expose_metrics: bool = False):
         self._get = dict(get_routes)
         self._post = dict(post_routes)
         # raw routes return (status, content_type, body_bytes) — the live
         # UI serves HTML through these; JSON routes stay JSON
         self._raw_get = dict(raw_get_routes or {})
+        if expose_metrics and "/metrics" not in self._raw_get:
+            self._raw_get["/metrics"] = _metrics_route
         self._port = int(port)
         self._host = host
-        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._pool_size = int(pool_size)
+        self._httpd: Optional[_PooledHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -88,20 +127,35 @@ class JsonHttpServer:
                     return
                 self._dispatch(post_routes, payload)
 
-        self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)
+        self._httpd = _PooledHTTPServer((self._host, self._port), Handler,
+                                        self._pool_size)
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
         return self
 
     def stop(self):
+        """Graceful: stop accepting (shutdown + close the listening
+        socket), then wait for every in-flight handler to finish."""
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
+            self._httpd.close_pool()
             self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
 
     def __enter__(self):
         return self.start()
 
     def __exit__(self, *exc):
         self.stop()
+
+
+def _metrics_route():
+    """GET /metrics — Prometheus text exposition of the process-global
+    registry (the same scrape surface UIServer exposes)."""
+    from ..optimize.metrics import registry
+    body = registry().prometheus_text().encode()
+    return 200, "text/plain; version=0.0.4; charset=utf-8", body

@@ -33,3 +33,10 @@ JAX_PLATFORMS=cpu python tests/smoke_compile_cache.py
 # in a second process, and assert bitwise-identical params vs an
 # uninterrupted same-seed control run.
 JAX_PLATFORMS=cpu python tests/smoke_resilience.py
+
+# Serving smoke (docs/serving.md): warmup a gateway, drive concurrent
+# HTTP /predict traffic through a live checkpoint hot-swap, and assert
+# zero dropped/errored requests, post-swap predictions bitwise from the
+# new checkpoint, ZERO XLA compiles after warmup, and the serving
+# metric families on the scrape surface.
+JAX_PLATFORMS=cpu python tests/smoke_serving.py
