@@ -332,8 +332,83 @@ def attention_train_flops_per_token(seq_len: int, width=256,
     return 3 * (proj + 2 * attn_per_layer)
 
 
+def attention_op_flops_per_token(seq_len: int, width=512, bwd=True,
+                                 causal_executed=True):
+    """Attention-op-only FLOPs per token (the projections are excluded —
+    bench_attention_ab times the bare op). Forward: 2 block matmuls
+    (QK^T, PV) over ~T/2 executed keys when causal. Backward: 5 block
+    matmuls (recompute s, then dv, dp, dk, dq), i.e. 2.5x forward — the
+    flash recompute schedule, which all three impls share in spirit
+    (dense re-materializes instead but runs the same contraction
+    count)."""
+    keys = seq_len // 2 if causal_executed else seq_len
+    fwd = 2 * 2 * width * keys
+    return fwd + (5 * 2 * width * keys if bwd else 0)
+
+
+def bench_attention_ab(seq_len=4096, width=512, heads=4, steps=3,
+                       repeats=3):
+    """Standing op-level A/B (ISSUE 7): fwd+bwd wall time of causal
+    dense vs blockwise vs fused-Pallas attention at the longctx geometry
+    (head_dim 128, tokens/step 32k). The dispatch rule in
+    ops.attention.select_attention_impl ships whatever wins here;
+    docs/perf_attention.md records the v5e sweep. Off-TPU the pallas
+    column is absent (probe fails → clean fallback, never a crash)."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.ops import attention as att
+    from deeplearning4j_tpu.ops import flash_attention as fa
+
+    batch = max(1, 32768 // seq_len)
+    d = width // heads
+    rng = np.random.default_rng(0)
+
+    def mk():
+        return jax.device_put(jnp.asarray(
+            rng.standard_normal((batch, seq_len, heads, d)), jnp.bfloat16))
+
+    q, k, v, g = mk(), mk(), mk(), mk()
+    impls = {"dense": lambda q, k, v: att.dense_attention(q, k, v,
+                                                          causal=True)}
+    blk = att.pick_block_size(seq_len, 0)
+    if blk:
+        impls["blockwise"] = lambda q, k, v: att.blockwise_attention(
+            q, k, v, causal=True, q_block=blk, kv_block=blk)
+    if fa.flash_attention_supported(seq_len, seq_len, d) and \
+            fa.flash_attention_available():
+        impls["pallas"] = lambda q, k, v: fa.flash_attention(
+            q, k, v, causal=True)
+
+    fpt = attention_op_flops_per_token(seq_len, width)
+    extras = {"batch": batch, "seq_len": seq_len}
+    best = None
+    for name, fn in impls.items():
+        def loss(q, k, v, fn=fn):
+            return jnp.sum(fn(q, k, v).astype(jnp.float32)
+                           * g.astype(jnp.float32))
+
+        step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        jax.block_until_ready(step(q, k, v))  # compile + warm
+        times = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            out = None
+            for _ in range(steps):
+                out = step(q, k, v)
+            jax.block_until_ready(out)
+            times.append(time.perf_counter() - t0)
+        dt = sorted(times)[len(times) // 2] / steps
+        tps = batch * seq_len / dt
+        extras[f"fwdbwd_ms_{name}"] = round(dt * 1e3, 2)
+        extras[f"est_mfu_{name}"] = _mfu(tps, fpt)
+        if best is None or tps > best[1]:
+            best = (name, tps)
+    extras["winner"] = best[0]
+    return best[1], extras
+
+
 def bench_attention_longctx(seq_len=8192, width=512, heads=4, steps=5,
-                            repeats=3):
+                            repeats=3, impl="auto"):
     """LONG-context single-chip training tokens/sec: 2-layer causal
     self-attention char model at seq 4k-16k where the [T, T] matrix
     dominates — routed through blockwise flash-style attention
@@ -357,9 +432,11 @@ def bench_attention_longctx(seq_len=8192, width=512, heads=4, steps=5,
     conf = (NeuralNetConfiguration.builder().seed(0)
             .updater(Sgd(0.1)).list()
             .layer(SelfAttentionLayer(n_out=width, n_heads=heads,
-                                      causal=True, activation="relu"))
+                                      causal=True, activation="relu",
+                                      attention_impl=impl))
             .layer(SelfAttentionLayer(n_out=width, n_heads=heads,
-                                      causal=True, activation="relu"))
+                                      causal=True, activation="relu",
+                                      attention_impl=impl))
             .layer(RnnOutputLayer(n_out=vocab, activation="softmax",
                                   loss="mcxent"))
             .set_input_type(InputType.recurrent(vocab))
@@ -383,7 +460,13 @@ def bench_attention_longctx(seq_len=8192, width=512, heads=4, steps=5,
     dt = sorted(times)[len(times) // 2]
     tps = (batch * seq_len * steps) / dt
     fpt = attention_train_flops_per_token(seq_len, width)
+    # the impl the dispatch actually picked for this geometry (same rule
+    # the layer trace ran — select is deterministic in (t, d, impl))
+    from deeplearning4j_tpu.ops.attention import select_attention_impl
+    picked = select_attention_impl(seq_len, width // heads,
+                                   requested=impl)
     return tps, {"batch": batch, "seq_len": seq_len,
+                 "attention_impl": picked,
                  "est_mfu": round(tps * fpt / TPU_V5E_BF16_PEAK, 3)}
 
 
@@ -722,6 +805,11 @@ def run_once(workload: str, arg):
         tps, ext = bench_attention_longctx(seq_len=seq)
         return (f"attention_longctx_seq{seq}_tokens_per_sec", tps,
                 "tokens/sec", ext)
+    if workload == "attention_ab":
+        seq = int(arg) if arg else 4096
+        tps, ext = bench_attention_ab(seq_len=seq)
+        return (f"attention_ab_seq{seq}_tokens_per_sec", tps,
+                "tokens/sec", ext)
     if workload == "resnet50":
         batch = int(arg) if arg else 1024
         ips = bench_resnet50(batch=batch)
@@ -730,7 +818,8 @@ def run_once(workload: str, arg):
                 {"est_mfu": _mfu(ips, RESNET50_TRAIN_FLOPS_PER_IMAGE)})
     raise SystemExit(
         f"Unknown workload {workload!r}; use resnet50 [batch] | vgg16 | "
-        "googlenet | attention | attention_longctx [seq] | alexnet | "
+        "googlenet | attention | attention_longctx [seq] | "
+        "attention_ab [seq] | alexnet | "
         "alexnet_pallaslrn | lenet | lenet_tiny | lstm | w2v [scale] | "
         "etl | lenet_hostfed | serving")
 

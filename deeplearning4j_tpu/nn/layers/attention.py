@@ -14,8 +14,8 @@ from dataclasses import dataclass
 
 import jax.numpy as jnp
 
-from ...ops.attention import (active_sequence_parallel, blockwise_attention,
-                              dense_attention, ring_self_attention)
+from ...ops.attention import (active_sequence_parallel, pick_block_size,
+                              ring_self_attention, single_device_attention)
 from ...utils import serde
 from .core import Layer, dropout
 
@@ -42,6 +42,14 @@ class SelfAttentionLayer(Layer):
     # whenever it divides t. Blockwise is bit-comparable to dense up to
     # f32 reassociation (ops/attention.py, tests/test_attention.py).
     block_size: int = 0
+    # Implementation override for the single-chip path: "auto" routes
+    # through ops.attention.select_attention_impl (fused Pallas flash
+    # kernel on TPU once t >= 2048, else blockwise/dense per the
+    # measured rule in docs/perf_attention.md); "pallas" / "blockwise" /
+    # "dense" force a path ("pallas" falls back with a one-shot warning
+    # when the kernel is unavailable). The ring path picks its own
+    # fused inner step (ring_self_attention use_flash auto).
+    attention_impl: str = "auto"
 
     def input_kind(self):
         return "rnn"
@@ -89,22 +97,9 @@ class SelfAttentionLayer(Layer):
 
     def _pick_block(self, t: int) -> int:
         """Block size for single-device blockwise attention; 0 = dense.
-        See the block_size field doc for the policy."""
-        if self.block_size == -1:
-            return 0
-        if self.block_size > 0:
-            # "whenever it divides t" (field doc) — including t ==
-            # block_size, where blockwise runs as a single block
-            # (ops/attention.py handles nq == nk == 1).
-            return self.block_size if t % self.block_size == 0 else 0
-        if t < 2048:
-            return 0
-        # 512 first: measured fastest on v5e (bf16, d<=128 heads) —
-        # 4k/8k/16k sweeps in docs/perf_attention.md
-        for blk in (512, 1024, 256, 128):
-            if t % blk == 0:
-                return blk
-        return 0
+        Policy lives in ops.attention.pick_block_size (shared with the
+        dispatch rule); see the block_size field doc."""
+        return pick_block_size(t, self.block_size)
 
     def forward(self, params, state, x, *, train=False, rng=None,
                 mask=None):
@@ -166,14 +161,11 @@ class SelfAttentionLayer(Layer):
                                       block_size=self._pick_block(
                                           t // seq_shards))
         else:
-            blk = self._pick_block(t)
-            if blk:
-                out = blockwise_attention(q, k, v, causal=self.causal,
-                                          key_mask=mask, q_block=blk,
-                                          kv_block=blk)
-            else:
-                out = dense_attention(q, k, v, causal=self.causal,
-                                      key_mask=mask)
+            # measured pallas/blockwise/dense dispatch + selection
+            # counter (ops.attention.select_attention_impl)
+            out = single_device_attention(
+                q, k, v, causal=self.causal, key_mask=mask,
+                impl=self.attention_impl, block_size=self.block_size)
         out = out.reshape(b, t, self.n_out)
         out = out @ params[W_O] + params[B_O]
         out = self._act()(out)

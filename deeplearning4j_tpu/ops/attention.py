@@ -62,6 +62,131 @@ def active_sequence_parallel():
     return _SEQ_PARALLEL[-1] if _SEQ_PARALLEL else None
 
 
+# --------------------------------------------------------------------------
+# Single-device dispatch: pallas (fused flash kernel) / blockwise / dense.
+# The rule is MEASURED, not aspirational — docs/perf_attention.md holds the
+# standing A/B (bench.py attention_ab) behind it.
+# --------------------------------------------------------------------------
+
+ATTENTION_IMPLS = ("pallas", "blockwise", "dense")
+
+
+def pick_block_size(t: int, block_size: int = 0) -> int:
+    """Block size for single-device blockwise attention; 0 = dense.
+    block_size: 0 = auto (blockwise once t >= 2048; probe order 512,
+    1024, 256, 128 — 512 measured fastest on v5e), -1 = always dense,
+    >0 = that block size whenever it divides t (including t == block,
+    a single-block run)."""
+    if block_size == -1:
+        return 0
+    if block_size > 0:
+        return block_size if t % block_size == 0 else 0
+    if t < 2048:
+        return 0
+    for blk in (512, 1024, 256, 128):
+        if t % blk == 0:
+            return blk
+    return 0
+
+
+def _pallas_ready(t_q: int, t_k: int, head_dim: int,
+                  interpret: bool) -> bool:
+    from . import flash_attention as fa
+    if not fa.flash_attention_supported(t_q, t_k, head_dim):
+        return False
+    return True if interpret else fa.flash_attention_available()
+
+
+def _warn_pallas_unavailable_once(t: int, head_dim: int) -> None:
+    if getattr(select_attention_impl, "_warned_pallas", False):
+        return
+    import logging
+    logging.getLogger(__name__).warning(
+        "attention impl 'pallas' requested but the fused kernel is "
+        "unavailable for t=%d head_dim=%d on this backend (%s); falling "
+        "back per the dispatch rule (docs/perf_attention.md)",
+        t, head_dim, jax.default_backend())
+    select_attention_impl._warned_pallas = True
+
+
+def _count_attention_impl(impl: str) -> None:
+    from ..optimize.metrics import registry
+    registry().counter(
+        "attention_kernel_selected_total",
+        "Attention implementations chosen at dispatch (trace) time",
+    ).labels(impl=impl).inc()
+
+
+def select_attention_impl(t_q: int, head_dim: int, *,
+                          requested: Optional[str] = None,
+                          block_size: int = 0,
+                          interpret: bool = False,
+                          t_k: Optional[int] = None) -> str:
+    """Pick 'pallas' | 'blockwise' | 'dense' for a single-device
+    attention call, increment `attention_kernel_selected_total{impl=}`,
+    and return the choice. Runs at TRACE time (shapes are static), so
+    the counter counts selections, not per-step executions.
+
+    Rule (measured A/B, docs/perf_attention.md): below t=2048 dense wins
+    (blockwise/pallas overheads don't amortize); from 2048 up the fused
+    Pallas kernel wins everywhere it compiles (TPU probe via
+    flash_attention_available, or interpret=True for CPU tests), else
+    blockwise, else dense. An explicit user block_size (> 0) keeps the
+    blockwise path — the user asked for that shape; block_size == -1
+    forces dense (the pre-existing contract). `requested` overrides
+    ('auto'/None = the rule); a requested-but-unavailable 'pallas' warns
+    once and falls through the same rule."""
+    t_k = t_q if t_k is None else t_k
+    req = None if requested in (None, "auto") else requested
+    if req is not None and req not in ATTENTION_IMPLS:
+        raise ValueError(f"attention impl {requested!r} not in "
+                         f"{ATTENTION_IMPLS + ('auto',)}")
+    if req == "dense":
+        choice = "dense"
+    else:
+        blk = pick_block_size(t_q, block_size)
+        if req == "pallas" and not _pallas_ready(t_q, t_k, head_dim,
+                                                 interpret):
+            _warn_pallas_unavailable_once(t_q, head_dim)
+            req = None
+        if req == "pallas":
+            choice = "pallas"
+        elif req == "blockwise":
+            choice = "blockwise" if blk else "dense"
+        elif (block_size == 0 and t_q >= 2048 and t_q == t_k
+                and _pallas_ready(t_q, t_k, head_dim, interpret)):
+            choice = "pallas"
+        else:
+            choice = "blockwise" if blk else "dense"
+    _count_attention_impl(choice)
+    return choice
+
+
+def single_device_attention(q, k, v, *, causal: bool = False,
+                            key_mask: Optional[jax.Array] = None,
+                            impl: Optional[str] = None,
+                            block_size: int = 0,
+                            interpret: bool = False) -> jax.Array:
+    """Dispatching front door for unsharded attention: routes to the
+    fused Pallas flash kernel, blockwise, or dense per
+    select_attention_impl. Same signature/semantics as dense_attention
+    plus the routing knobs; SelfAttentionLayer's single-chip path calls
+    this."""
+    choice = select_attention_impl(q.shape[1], q.shape[-1],
+                                   requested=impl, block_size=block_size,
+                                   interpret=interpret, t_k=k.shape[1])
+    if choice == "pallas":
+        from .flash_attention import flash_attention
+        return flash_attention(q, k, v, causal=causal, key_mask=key_mask,
+                               interpret=interpret)
+    if choice == "blockwise":
+        blk = pick_block_size(q.shape[1], block_size)
+        return blockwise_attention(q, k, v, causal=causal,
+                                   key_mask=key_mask, q_block=blk,
+                                   kv_block=blk)
+    return dense_attention(q, k, v, causal=causal, key_mask=key_mask)
+
+
 def dense_attention(q, k, v, *, causal: bool = False,
                     key_mask: Optional[jax.Array] = None) -> jax.Array:
     """Plain softmax attention. q/k/v: [batch, time, heads, head_dim];
@@ -272,12 +397,70 @@ def _ring_body(axis: str, n_dev: int, t_loc: int, causal: bool,
     return fn
 
 
+def _ring_body_flash(axis: str, n_dev: int, t_loc: int, causal: bool,
+                     q_block: int, kv_block: int, interpret: bool):
+    """Fused-kernel ring inner step (runs inside shard_map): each hop
+    runs the Pallas flash kernel over the local Q against the visiting
+    K/V block — with KV positions offset by the TRACED source index, so
+    causal masking and the kernel's block-skip predicate see global
+    coordinates — then merges the hop's normalized (o, lse) pair into
+    the running accumulator:
+
+        new = max(lse_acc, lse_hop); w_i = exp(lse_i - new)
+        o_acc = (o_acc*w_acc + o_hop*w_hop) / (w_acc + w_hop)
+        lse_acc = new + log(w_acc + w_hop)
+
+    which is exact softmax reassociation (each o is normalized w.r.t.
+    its own lse). Fully-masked hops come back as (0, NEG) and merge as
+    weight-0; rows masked across ALL hops output zero, matching
+    dense_attention. Differentiable: the merge consumes lse, whose
+    cotangent the kernel's custom_vjp supports (ds += p * g_lse)."""
+
+    def fn(q, k, v, key_mask):
+        from .flash_attention import flash_attention
+        b, _, h, d = q.shape
+        my = jax.lax.axis_index(axis)
+        q_pos = my * t_loc + jnp.arange(t_loc, dtype=jnp.int32)
+        o_acc = jnp.zeros((b, t_loc, h, d), jnp.float32)
+        lse_acc = jnp.full((b, t_loc, h), NEG, jnp.float32)
+        k_blk, v_blk, km_blk = k, v, key_mask
+        for s in range(n_dev):  # static unroll (see _ring_body)
+            src = (my - s) % n_dev
+            kv_pos = src * t_loc + jnp.arange(t_loc, dtype=jnp.int32)
+            o_hop, lse_hop = flash_attention(
+                q, k_blk, v_blk, causal=causal, key_mask=km_blk,
+                q_pos=q_pos, kv_pos=kv_pos, q_block=q_block,
+                kv_block=kv_block, interpret=interpret, with_lse=True)
+            new = jnp.maximum(lse_acc, lse_hop)
+            w_acc = jnp.exp(lse_acc - new)
+            w_hop = jnp.exp(lse_hop - new)
+            denom = w_acc + w_hop
+            o_acc = (o_acc * w_acc[..., None]
+                     + o_hop.astype(jnp.float32) * w_hop[..., None]) \
+                / denom[..., None]
+            lse_acc = jnp.where(new <= NEG / 2, NEG,
+                                new + jnp.log(denom))
+            if s < n_dev - 1:
+                perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+                k_blk = jax.lax.ppermute(k_blk, axis, perm)
+                v_blk = jax.lax.ppermute(v_blk, axis, perm)
+                if km_blk is not None:
+                    km_blk = jax.lax.ppermute(km_blk, axis, perm)
+        return o_acc.astype(q.dtype)
+
+    return fn
+
+
 def ring_self_attention(q, k, v, mesh, *, axis: str = "seq",
                         causal: bool = False,
                         key_mask: Optional[jax.Array] = None,
                         batch_axis: Optional[str] = None,
                         head_axis: Optional[str] = None,
-                        block_size: int = 0) -> jax.Array:
+                        block_size: int = 0,
+                        use_flash: Optional[bool] = None,
+                        flash_interpret: bool = False,
+                        flash_q_block: int = 0,
+                        flash_kv_block: int = 0) -> jax.Array:
     """Sequence-parallel attention: q/k/v [batch, time, heads, head_dim]
     with TIME sharded over `axis` of `mesh` (and, optionally, BATCH
     sharded over `batch_axis` — the DP x SP layout — and HEADS over
@@ -286,7 +469,13 @@ def ring_self_attention(q, k, v, mesh, *, axis: str = "seq",
     Returns the attention output with the same sharding. Fully
     differentiable: the VJP retraces the ring in reverse (ppermute
     transposes to the inverse permutation), so this is a trainable path,
-    not just a forward op. See module docstring."""
+    not just a forward op. See module docstring.
+
+    `use_flash` selects the fused Pallas kernel as the per-hop inner
+    step (_ring_body_flash): None = auto — on when the kernel compiles
+    for the per-device geometry (TPU probe, or flash_interpret=True for
+    CPU tests), off otherwise, so CPU parity tests keep exercising the
+    legacy scan body unchanged."""
     n_dev = int(mesh.shape[axis])
     t = q.shape[1]
     if t % n_dev:
@@ -300,7 +489,26 @@ def ring_self_attention(q, k, v, mesh, *, axis: str = "seq",
         raise ValueError(
             f"per-device time {t // n_dev} must divide "
             f"block_size={block_size}")
-    body = _ring_body(axis, n_dev, t // n_dev, causal, block_size)
+    t_loc = t // n_dev
+    if use_flash is None:
+        from . import flash_attention as fa
+        use_flash = (
+            fa.flash_attention_supported(t_loc, t_loc, q.shape[-1],
+                                         q_block=flash_q_block,
+                                         kv_block=flash_kv_block)
+            and (flash_interpret or fa.flash_attention_available()))
+    if use_flash:
+        from . import flash_attention as fa
+        qb = flash_q_block or fa.pick_kernel_block(t_loc,
+                                                   fa.DEFAULT_BLOCK_Q)
+        kb = flash_kv_block or fa.pick_kernel_block(t_loc,
+                                                    fa.DEFAULT_BLOCK_KV)
+        _count_attention_impl("pallas")
+        body = _ring_body_flash(axis, n_dev, t_loc, causal, qb, kb,
+                                flash_interpret)
+    else:
+        _count_attention_impl("blockwise" if block_size else "dense")
+        body = _ring_body(axis, n_dev, t_loc, causal, block_size)
     spec_qkv = P(batch_axis, axis, head_axis, None)
     from ..parallel.mesh import shard_map_compat
     if key_mask is None:

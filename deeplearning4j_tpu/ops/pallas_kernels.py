@@ -35,7 +35,7 @@ from __future__ import annotations
 
 import functools
 import logging
-from typing import Tuple
+from typing import Callable, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -44,6 +44,57 @@ from jax import lax
 log = logging.getLogger(__name__)
 
 _ROW_BLOCK = 256  # flattened pixel rows per grid step (VMEM-friendly)
+
+
+# ---------------------------------------------------------------------------
+# Shared kernel plumbing (used by LRN here and flash attention in
+# ops/flash_attention.py — factor, don't copy a third time).
+# ---------------------------------------------------------------------------
+
+def pad_axis_to(a, axis: int, multiple: int):
+    """Zero-pad `a` along `axis` up to the next multiple of `multiple`.
+
+    Returns the (possibly identical) array. The caller slices the result
+    back; doing the pad OUTSIDE the custom_vjp'd pallas_call means
+    autodiff handles the pad/slice pair for free."""
+    size = a.shape[axis]
+    pad = (-size) % multiple
+    if pad == 0:
+        return a
+    widths = [(0, 0)] * a.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(a, widths)
+
+
+_probe_results: Dict[str, bool] = {}
+
+
+def kernel_probe(name: str, probe: Callable[[], None]) -> bool:
+    """One-time compile probe for a Pallas kernel, cached per `name`.
+
+    try/except around a traced call CANNOT catch Pallas lowering failures
+    (they surface at jit-compile time), so the optional-helper fallback
+    is decided here, eagerly, once — the actual 'helper != null' check.
+
+    The first call usually happens while a layer forward is being TRACED
+    (gating runs inside jit), where a bare jnp.ones would produce a
+    tracer and the probe would throw and cache False — permanently
+    disabling the kernel for the whole process (the round-4 GoogLeNet
+    profile caught exactly this: zero Mosaic calls in a "Pallas" run).
+    ensure_compile_time_eval makes the probe eager regardless of any
+    ambient trace."""
+    cached = _probe_results.get(name)
+    if cached is not None:
+        return cached
+    try:
+        with jax.ensure_compile_time_eval():
+            probe()
+        _probe_results[name] = True
+    except Exception as e:
+        log.info("Pallas %s kernel unavailable (%s); fallback path",
+                 name, e)
+        _probe_results[name] = False
+    return _probe_results[name]
 
 
 def lrn_reference(x, k: float, alpha: float, beta: float, n: int):
@@ -124,14 +175,10 @@ def _run_lrn_call(kernel, arrays, k, alpha, beta, n, interpret):
 
     b, h, w, c = arrays[0].shape
     rows = b * h * w
-    c_pad = (-c) % 128
-    r_pad = (-rows) % _ROW_BLOCK
     flats = []
     for a in arrays:
-        flat = a.reshape(rows, c)
-        if c_pad or r_pad:
-            flat = jnp.pad(flat, ((0, r_pad), (0, c_pad)))
-        flats.append(flat)
+        flat = pad_axis_to(a.reshape(rows, c), 1, 128)
+        flats.append(pad_axis_to(flat, 0, _ROW_BLOCK))
     padded_rows, padded_c = flats[0].shape
     kern = functools.partial(kernel, k=float(k), alpha=float(alpha),
                              beta=float(beta), n=int(n))
@@ -184,31 +231,13 @@ def lrn_supported(x) -> bool:
     return _ROW_BLOCK * padded_c * 4 * 4 <= 8 * 1024 * 1024  # ≤ c=2048 f32
 
 
-_probe_result = None
+def _lrn_probe():
+    x = jnp.ones((1, 1, 1, 8), jnp.float32)
+    _lrn_pallas(x, 2.0, 1e-4, 0.75, 5, False).block_until_ready()
 
 
 def tpu_kernel_available() -> bool:
-    """One-time compile probe. try/except around a traced call CANNOT
-    catch Pallas lowering failures (they surface at jit-compile time), so
-    the optional-helper fallback is decided here, eagerly, once — the
-    actual 'helper != null' check.
-
-    The probe's first call usually happens while a layer forward is
-    being TRACED (the gating runs inside jit), where a bare jnp.ones
-    would produce a tracer and the probe would throw and cache False —
-    permanently disabling the kernel for the whole process (the round-4
-    GoogLeNet profile caught exactly this: zero Mosaic calls in a
-    "Pallas" run). ensure_compile_time_eval makes the probe eager
-    regardless of any ambient trace."""
-    global _probe_result
-    if _probe_result is None:
-        try:
-            with jax.ensure_compile_time_eval():
-                x = jnp.ones((1, 1, 1, 8), jnp.float32)
-                _lrn_pallas(x, 2.0, 1e-4, 0.75, 5,
-                            False).block_until_ready()
-            _probe_result = True
-        except Exception as e:
-            log.info("Pallas LRN kernel unavailable (%s); lax path", e)
-            _probe_result = False
-    return _probe_result
+    """One-time compile probe for the LRN kernel (see kernel_probe for
+    the eager-probe rationale — a traced first call once silently
+    disabled the kernel for the whole process)."""
+    return kernel_probe("lrn", _lrn_probe)

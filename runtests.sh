@@ -14,6 +14,13 @@ make -C native || echo "native ETL build unavailable; numpy fallbacks"
 # finding — before spending minutes on the pytest suite.
 JAX_PLATFORMS=cpu python tests/smoke_analysis.py
 
+# Attention-kernel smoke (docs/perf_attention.md): interpret-mode fwd+bwd
+# parity of the fused Pallas flash kernel vs dense_attention, plus the
+# pallas/blockwise/dense dispatch fallback contract off-TPU (no crash,
+# counter incremented, one-shot warning). Cheap (seconds) — gates before
+# the suite like the jaxlint step.
+JAX_PLATFORMS=cpu python tests/smoke_attention.py
+
 python -m pytest tests/ -q "$@"
 
 # Observability smoke (docs/observability.md): a real 2-epoch fit with
