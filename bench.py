@@ -679,12 +679,26 @@ def bench_serving(clients=8, requests_per_client=200, batch_limit=8):
     served_rows = sum(entry.engine.executed_batch_sizes)
     gw.pool.shutdown()
     lat = st["latency"].get("default", {})
+    # Serving-resilience counters (docs/serving.md) ride the extras so
+    # every BENCH_*.json records chaos activity — including its absence
+    # (all zeros on a healthy run).
+    from deeplearning4j_tpu.optimize.metrics import registry as _reg
+    reg = _reg()
     return total / dt, {
         "clients": clients,
         "p50_ms": lat.get("p50_ms", 0.0),
         "p99_ms": lat.get("p99_ms", 0.0),
         "shed": entry.engine.total_shed,
         "rows_per_forward": round(served_rows / forwards, 2),
+        "batch_failures": int(reg.counter(
+            "serving_batch_failures_total").total()),
+        "breaker_transitions": int(reg.counter(
+            "serving_breaker_transitions_total").total()),
+        "breaker_state": int(reg.gauge(
+            "serving_breaker_state").value(model="default")),
+        "swaps_canary_rejected": int(reg.counter(
+            "serving_swaps_total").value(model="default",
+                                         outcome="canary_rejected")),
     }
 
 
@@ -846,6 +860,11 @@ def main():
         # activity — including its absence — in every snapshot
         # (docs/robustness.md).
         resilience.register_metrics()
+        # Same for the serving-resilience families (breaker states,
+        # batch failures, canary rejections — docs/serving.md): the
+        # chaos counters ride every BENCH snapshot.
+        from deeplearning4j_tpu.serving import breaker as serving_breaker
+        serving_breaker.register_metrics()
         with CompilationTracker() as trk:
             metric, ips, unit, extra = run_once(workload, arg)
         # XLA compilations the measurement triggered: warm-up should own

@@ -109,6 +109,20 @@ class _Family:
         with self._lock:
             return child._value
 
+    def total(self) -> float:
+        """Sum of this family's value across every label set (the
+        label-blind aggregate bench extras and health summaries want:
+        e.g. breaker transitions regardless of target state).
+        Histograms aggregate their observation counts."""
+        with self._lock:
+            if isinstance(self, Histogram):
+                vals = [c._n for c in self._children.values()]
+                vals.append(self._n)
+            else:
+                vals = [c._value for c in self._children.values()]
+                vals.append(self._value)
+            return float(sum(vals))
+
 
 class Counter(_Family):
     """Monotonic counter (Prometheus counter semantics)."""

@@ -30,6 +30,7 @@ from typing import Callable, List, Optional
 import numpy as np
 
 from ..data.padding import next_pow2_bucket, repeat_tail_rows
+from ..utils import faults
 
 
 class InferenceMode(enum.Enum):
@@ -41,6 +42,20 @@ class InferenceMode(enum.Enum):
 class ServerClosedError(RuntimeError):
     """The server was shut down while (or before) this request was
     queued — the caller gets this instead of hanging forever."""
+
+
+class BatchExecutionError(RuntimeError):
+    """A coalesced forward raised: only the requests riding THAT batch
+    fail (with this typed wrapper; `__cause__` carries the original
+    exception) — batchmates of a poisoned request are retried alone,
+    later batches are unaffected, and the collector thread survives.
+    The circuit breaker (serving/breaker.py) counts these."""
+
+
+class NonFiniteOutputError(BatchExecutionError):
+    """A forward returned NaN/Inf rows with `check_finite` on — the
+    poisoned-model signal that trips a circuit breaker immediately
+    instead of waiting out N consecutive failures."""
 
 
 class QueueFullError(RuntimeError):
@@ -82,7 +97,7 @@ class ParallelInference:
 
     def __init__(self, model, *, inference_mode: InferenceMode = InferenceMode.BATCHED,
                  batch_limit: int = 32, queue_limit: int = 64,
-                 batch_timeout_ms: float = 2.0):
+                 batch_timeout_ms: float = 2.0, check_finite: bool = False):
         if not getattr(model, "_initialized", False):
             raise RuntimeError("Model must be init()ed (or restored) before "
                                "serving")
@@ -90,6 +105,11 @@ class ParallelInference:
         self.inference_mode = inference_mode
         self.batch_limit = int(batch_limit)
         self.batch_timeout_ms = float(batch_timeout_ms)
+        # check_finite: scan each forward's output for NaN/Inf and fail
+        # the batch with NonFiniteOutputError (the breaker's instant
+        # trip). Off by default — the host-side isfinite scan is cheap
+        # but not free; ModelPool turns it on for served entries.
+        self.check_finite = bool(check_finite)
         self._lock = threading.Lock()
         self._enqueue_lock = threading.Lock()
         self._queue: "queue.Queue[_Request]" = queue.Queue(maxsize=queue_limit)
@@ -100,6 +120,7 @@ class ParallelInference:
         self.executed_batch_sizes = collections.deque(maxlen=1024)
         self.total_forwards = 0
         self.total_shed = 0
+        self.total_batch_failures = 0
         # EWMA of one coalesced forward's wall time (written under
         # self._lock right after the forward it measures; the admission
         # estimate reads it lock-free — a stale float is fine there).
@@ -107,9 +128,13 @@ class ParallelInference:
         # Buckets warmup() precompiled — the hot-swap warm set.
         self.warmed_buckets: List[int] = []
         # Gateway hooks: on_shed(request, reason) on every deadline drop;
-        # on_batch(requests, rows, bucket, dur_s) after every forward.
+        # on_batch(requests, rows, bucket, dur_s) after every forward;
+        # on_batch_error(exc, n_requests) after every FAILED forward
+        # (the breaker/metrics seam — called once per failed forward
+        # attempt, including the solo retries of a poisoned batch).
         self.on_shed: Optional[Callable] = None
         self.on_batch: Optional[Callable] = None
+        self.on_batch_error: Optional[Callable] = None
         if inference_mode == InferenceMode.BATCHED:
             self._worker = threading.Thread(
                 target=self._collector_loop, name="ParallelInference-collector",
@@ -195,7 +220,15 @@ class ParallelInference:
                     self._shed(req, "expired")
                     raise DeadlineExceededError(
                         "deadline passed before dispatch")
-                return self._forward(x)
+                try:
+                    out = self._forward(x)
+                    self._require_finite(out)
+                except (DeadlineExceededError, QueueFullError,
+                        ServerClosedError):
+                    raise
+                except BaseException as e:
+                    raise self._batch_failure(e, 1)
+                return out
         req = _Request(x, deadline)
         # Enqueue under the same lock shutdown() uses to place its sentinel,
         # so no request can ever land BEHIND the sentinel and starve.
@@ -224,7 +257,34 @@ class ParallelInference:
                 pass  # a broken hook must never take the server down
 
     def _forward(self, x: np.ndarray) -> np.ndarray:
+        # Chaos seam (docs/robustness.md): armed "serve.forward" plans
+        # fail or delay this forward deterministically by call ordinal.
+        faults.fire("serve.forward")
         return self.model.output(x)
+
+    def _require_finite(self, out) -> None:
+        if self.check_finite and not np.isfinite(np.asarray(out)).all():
+            raise NonFiniteOutputError(
+                "forward returned non-finite (NaN/Inf) outputs")
+
+    def _batch_failure(self, e: BaseException,
+                       n_requests: int) -> BatchExecutionError:
+        """Record one failed forward attempt and return the typed error
+        the affected callers will see (original exception chained)."""
+        if isinstance(e, BatchExecutionError):
+            err = e
+        else:
+            err = BatchExecutionError(
+                f"forward failed for a {n_requests}-request batch: {e}")
+            err.__cause__ = e
+        self.total_batch_failures += 1
+        cb = self.on_batch_error
+        if cb is not None:
+            try:
+                cb(err, n_requests)
+            except Exception:
+                pass  # a broken hook must never take the server down
+        return err
 
     # -------------------------------------------------------------- collector
     def _collector_loop(self):
@@ -355,6 +415,7 @@ class ParallelInference:
                 # enough not to flap on one slow batch.
                 self._ewma_batch_s = dur if self._ewma_batch_s <= 0.0 \
                     else 0.8 * self._ewma_batch_s + 0.2 * dur
+            self._require_finite(out)
             self.executed_batch_sizes.append(n)
             self.total_forwards += 1
             cb = self.on_batch
@@ -370,8 +431,14 @@ class ParallelInference:
                 ofs += k
                 r.event.set()
         except BaseException as e:
+            # Batch-failure isolation: the failed forward is recorded
+            # (on_batch_error feeds the breaker + metrics), the affected
+            # futures fail with a TYPED error, and the collector thread
+            # survives to run the next batch — a raising forward never
+            # strands a caller and never kills the engine.
+            err = self._batch_failure(e, len(batch))
             if len(batch) == 1:
-                batch[0].error = e
+                batch[0].error = err
                 batch[0].event.set()
                 return
             # One bad request must not poison its batchmates: retry each
@@ -434,6 +501,7 @@ class ParallelInferenceBuilder:
         self._batch_limit = 32
         self._queue_limit = 64
         self._timeout_ms = 2.0
+        self._check_finite = False
 
     def inference_mode(self, mode: InferenceMode):
         self._mode = mode
@@ -451,8 +519,13 @@ class ParallelInferenceBuilder:
         self._timeout_ms = float(ms)
         return self
 
+    def check_finite(self, enabled: bool = True):
+        self._check_finite = bool(enabled)
+        return self
+
     def build(self) -> ParallelInference:
         return ParallelInference(
             self._model, inference_mode=self._mode,
             batch_limit=self._batch_limit, queue_limit=self._queue_limit,
-            batch_timeout_ms=self._timeout_ms)
+            batch_timeout_ms=self._timeout_ms,
+            check_finite=self._check_finite)

@@ -22,13 +22,23 @@ next forward, no epoch barriers) and SLO-aware adaptive shedding
 re-shaped for the static-shape XLA world: the batch axis quantizes to
 power-of-two buckets so the executable set is finite and precompiled.
 
+Resilience (docs/serving.md): each model's circuit breaker
+(serving/breaker.py) sits in front of admission — open state fast-fails
+/predict with a distinct 503 `breaker_open` status, and `/health`
+reports `degraded` while any breaker is not closed. Forward failures
+surface as typed 5xx statuses (`batch_failed` / `nonfinite`), never
+hangs.
+
 Endpoints: POST /predict, POST /swap, GET /health, GET /models,
 GET /stats, GET /metrics (Prometheus exposition — scrape surface shared
 with UIServer, docs/observability.md). Metrics:
 `serving_requests_total{model,status}`, `serving_admitted_total`,
 `serving_shed_total{model,reason}`, `serving_swaps_total{model,outcome}`,
-`serving_queue_depth{model}`, `serving_latency_ms{model}` histogram plus
-scrape-time `serving_latency_p50_ms`/`serving_latency_p99_ms` gauges.
+`serving_queue_depth{model}`, `serving_batch_failures_total{model}`,
+`serving_breaker_state{model}`,
+`serving_breaker_transitions_total{model,to}`,
+`serving_latency_ms{model}` histogram plus scrape-time
+`serving_latency_p50_ms`/`serving_latency_p99_ms` gauges.
 Every request runs inside a `serve/request` tracing span.
 """
 from __future__ import annotations
@@ -42,9 +52,11 @@ import numpy as np
 
 from ..optimize import tracing
 from ..optimize.metrics import registry
-from ..parallel.inference import (DeadlineExceededError, QueueFullError,
+from ..parallel.inference import (BatchExecutionError, DeadlineExceededError,
+                                  NonFiniteOutputError, QueueFullError,
                                   ServerClosedError)
 from ..utils.http_server import JsonHttpServer
+from .breaker import BreakerOpenError
 from .model_pool import ModelPool, SwapError
 
 __all__ = ["ServingGateway"]
@@ -122,7 +134,10 @@ class ServingGateway(JsonHttpServer):
                 deadline_ms: Optional[float] = None) -> np.ndarray:
         """In-process entry point (the HTTP route is a thin wrapper).
         Raises DeadlineExceededError / QueueFullError on shed,
-        KeyError on unknown model."""
+        BreakerOpenError when the model's circuit breaker fast-fails
+        the request, BatchExecutionError (NonFiniteOutputError for
+        NaN/Inf outputs) when the forward itself failed, KeyError on
+        unknown model."""
         # Unknown model: plain KeyError, no metrics — client-supplied
         # junk names must not mint unbounded label cardinality.
         entry = self.pool.get(name)
@@ -134,6 +149,17 @@ class ServingGateway(JsonHttpServer):
             deadline = None if deadline_ms is None else \
                 time.monotonic() + float(deadline_ms) / 1000.0
             with tracing.span("serve/request", model=name):
+                # Circuit breaker (docs/serving.md): an open breaker
+                # fast-fails BEFORE admission — no queue slot, no
+                # forward rows, a distinct terminal status. Half-open
+                # admits one probe; its forward outcome re-closes or
+                # re-opens the breaker via the engine hooks.
+                br = entry.breaker
+                if br is not None and not br.allow():
+                    status = "breaker_open"
+                    raise BreakerOpenError(
+                        f"model {name!r} circuit breaker is "
+                        f"{br.state} — fast-failing without queuing")
                 if deadline is not None:
                     # SLO-aware admission: estimated completion past the
                     # deadline means this request can only waste a queue
@@ -211,7 +237,14 @@ class ServingGateway(JsonHttpServer):
 
     # --------------------------------------------------------------- routes
     def _health_route(self, _):
-        return 200, {"status": "ok", "models": sorted(self.pool.names())}
+        # Degraded = any model's breaker is not closed: the gateway is
+        # up, but some traffic is being fast-failed (docs/serving.md).
+        breakers = {e.name: e.breaker.state
+                    for e in self.pool.entries() if e.breaker is not None}
+        degraded = sorted(n for n, s in breakers.items() if s != "closed")
+        return 200, {"status": "degraded" if degraded else "ok",
+                     "models": sorted(self.pool.names()),
+                     "breakers": breakers, "degraded": degraded}
 
     def _models_route(self, _):
         return 200, {"models": self.pool.describe()}
@@ -227,11 +260,20 @@ class ServingGateway(JsonHttpServer):
             out = self.predict(name, x, deadline_ms=deadline_ms)
         except KeyError as e:
             return 404, {"status": "error", "error": str(e)}
+        except BreakerOpenError as e:
+            return 503, {"status": "unavailable", "reason": "breaker_open",
+                         "error": str(e)}
         except QueueFullError as e:
             return 429, {"status": "shed", "reason": "queue_full",
                          "error": str(e)}
         except DeadlineExceededError as e:
             return 503, {"status": "shed", "reason": "deadline",
+                         "error": str(e)}
+        except NonFiniteOutputError as e:
+            return 500, {"status": "error", "reason": "nonfinite",
+                         "error": str(e)}
+        except BatchExecutionError as e:
+            return 500, {"status": "error", "reason": "batch_failed",
                          "error": str(e)}
         except ServerClosedError as e:
             return 503, {"status": "error", "error": str(e)}

@@ -23,9 +23,18 @@ protocol:
    are restored before the lock is released and the swap reports
    failed; traffic never sees half-swapped params.
 
+4½. **Canary gate** (docs/serving.md) — after pause-assign-warm, a
+   retained golden batch runs through the NEW params; non-finite
+   outputs (or drift past the optional `canary_max_drift` knob vs the
+   OLD params' outputs on the same batch) auto-roll back to the old
+   tree and raise `SwapError`, counted as
+   `serving_swaps_total{outcome="canary_rejected"}` — a checkpoint
+   that passes its sha256 gate but computes garbage never reaches
+   traffic.
+
 Swap outcomes land in `serving_swaps_total{model,outcome}`; per-model
-queue depth is sampled into `serving_queue_depth{model}` at scrape
-time.
+queue depth is sampled into `serving_queue_depth{model}` and breaker
+state into `serving_breaker_state{model}` at scrape time.
 """
 from __future__ import annotations
 
@@ -35,29 +44,50 @@ import weakref
 import zipfile
 from typing import Any, Dict, List, Optional
 
+import numpy as np
+
+from ..data.padding import next_pow2_bucket, repeat_tail_rows
 from ..optimize import tracing
 from ..optimize.metrics import registry
-from ..parallel.inference import InferenceMode, ParallelInference
+from ..parallel.inference import (InferenceMode, NonFiniteOutputError,
+                                  ParallelInference)
+from ..utils import faults
 from ..utils.model_serializer import (PARAMS_ENTRY, STATE_ENTRY,
                                       CheckpointCorruptError,
                                       _npz_bytes_to_tree, _read_entry,
                                       validate_checkpoint)
+from .breaker import STATE_VALUES, CircuitBreaker
 
 __all__ = ["ModelEntry", "ModelPool", "SwapError"]
 
 
 class SwapError(RuntimeError):
     """Hot-swap refused: no CheckpointManager attached, no valid
-    checkpoint published, architecture mismatch, or the warm forward
-    failed (in which case the old params were rolled back and are still
-    serving)."""
+    checkpoint published, architecture mismatch, the warm forward
+    failed, or the canary gate rejected the new params (in the latter
+    two cases the old params were rolled back and are still serving)."""
+
+
+class _CanaryRejected(RuntimeError):
+    """Internal: the post-warm golden-batch check failed — distinguishes
+    the canary_rejected swap outcome from a plain warm failure."""
 
 
 def _swap_counter(name: str, outcome: str):
     registry().counter(
         "serving_swaps_total",
-        "Checkpoint hot-swap attempts by outcome (ok/noop/failed)"
+        "Checkpoint hot-swap attempts by outcome "
+        "(ok/noop/failed/canary_rejected)"
         ).labels(model=name, outcome=outcome).inc()
+
+
+def _golden_forward(model, golden: np.ndarray) -> np.ndarray:
+    """Run the golden batch through the model padded to its pow2 bucket
+    (the same rule the engine coalesces to, so a warmed server compiles
+    nothing here) and slice the real rows back."""
+    n = golden.shape[0]
+    xs = repeat_tail_rows(golden, next_pow2_bucket(n) - n)
+    return np.asarray(model.output(xs))[:n]
 
 
 class ModelEntry:
@@ -65,11 +95,21 @@ class ModelEntry:
     and the checkpoint source it hot-swaps from."""
 
     def __init__(self, name: str, model, engine: ParallelInference,
-                 checkpoints=None):
+                 checkpoints=None, breaker: Optional[CircuitBreaker] = None,
+                 golden_batch: Optional[np.ndarray] = None,
+                 canary_max_drift: Optional[float] = None):
         self.name = name
         self.model = model
         self.engine = engine
         self.checkpoints = checkpoints
+        self.breaker = breaker
+        # Canary substrate: a small retained input batch (provided, or
+        # captured from the first served request) replayed through new
+        # params before a swap promotes them; `canary_max_drift` bounds
+        # max|new - old| output drift on it (None = finiteness only).
+        self.golden_batch = None if golden_batch is None else \
+            np.asarray(golden_batch)
+        self.canary_max_drift = canary_max_drift
         # Manifest record of the checkpoint currently serving; empty
         # until the first swap (initial params came from the caller,
         # not a published checkpoint).
@@ -77,7 +117,7 @@ class ModelEntry:
         self.swaps = 0
 
     def describe(self) -> Dict[str, Any]:
-        return {
+        out = {
             "model": self.name,
             "version": self.version.get("file", "initial"),
             "iteration": int(getattr(self.model, "iteration", 0)),
@@ -86,7 +126,11 @@ class ModelEntry:
             "warmed_buckets": list(self.engine.warmed_buckets),
             "total_forwards": self.engine.total_forwards,
             "total_shed": self.engine.total_shed,
+            "total_batch_failures": self.engine.total_batch_failures,
         }
+        if self.breaker is not None:
+            out["breaker"] = self.breaker.describe()
+        return out
 
 
 class ModelPool:
@@ -106,8 +150,14 @@ class ModelPool:
                 return
             g = reg.gauge("serving_queue_depth",
                           "Requests queued per served model")
+            bg = reg.gauge("serving_breaker_state",
+                           "Circuit breaker state per model (0=closed, "
+                           "1=open, 2=half_open)")
             for e in pool.entries():
                 g.labels(model=e.name).set(e.engine.queue_depth())
+                if e.breaker is not None:
+                    bg.labels(model=e.name).set(
+                        STATE_VALUES[e.breaker.state])
 
         registry().register_collector(_collect)
 
@@ -115,20 +165,41 @@ class ModelPool:
     def add(self, name: str, model, *, checkpoints=None,
             batch_limit: int = 32, queue_limit: int = 256,
             batch_timeout_ms: float = 2.0,
-            inference_mode: InferenceMode = InferenceMode.BATCHED
-            ) -> ModelEntry:
+            inference_mode: InferenceMode = InferenceMode.BATCHED,
+            check_finite: bool = True,
+            breaker: Optional[CircuitBreaker] = None,
+            breaker_threshold: int = 5,
+            breaker_reset_s: float = 30.0,
+            golden_batch=None,
+            canary_max_drift: Optional[float] = None) -> ModelEntry:
         """Register an init()ed model under `name` behind a fresh
         continuous-batching engine. `checkpoints` (a CheckpointManager
-        or a directory path) enables hot-swap for this entry."""
+        or a directory path) enables hot-swap for this entry.
+
+        Resilience knobs (docs/serving.md): `check_finite` fails a
+        forward whose outputs carry NaN/Inf (on by default for served
+        entries — the breaker's instant trip); `breaker` (or
+        `breaker_threshold`/`breaker_reset_s` for the default one)
+        guards this entry's /predict path; `golden_batch` seeds the
+        swap canary input (otherwise the first served request's rows
+        are retained); `canary_max_drift` bounds output drift a swap
+        may introduce on the golden batch (None = finiteness only)."""
         if isinstance(checkpoints, (str, os.PathLike)):
             from ..optimize.resilience import CheckpointManager
             checkpoints = CheckpointManager(checkpoints)
         engine = ParallelInference(
             model, inference_mode=inference_mode, batch_limit=batch_limit,
-            queue_limit=queue_limit, batch_timeout_ms=batch_timeout_ms)
-        entry = ModelEntry(name, model, engine, checkpoints)
-        # Engine-level telemetry hooks: late (in-queue) deadline sheds
-        # and per-forward batch stats, labeled by model.
+            queue_limit=queue_limit, batch_timeout_ms=batch_timeout_ms,
+            check_finite=check_finite)
+        if breaker is None:
+            breaker = CircuitBreaker(name,
+                                     failure_threshold=breaker_threshold,
+                                     reset_timeout_s=breaker_reset_s)
+        entry = ModelEntry(name, model, engine, checkpoints,
+                           breaker=breaker, golden_batch=golden_batch,
+                           canary_max_drift=canary_max_drift)
+        # Engine-level telemetry hooks: late (in-queue) deadline sheds,
+        # per-forward batch stats, and batch failures, labeled by model.
         reg = registry()
         shed_c = reg.counter(
             "serving_shed_total",
@@ -140,17 +211,33 @@ class ModelPool:
         fill_h = reg.histogram(
             "serving_batch_rows",
             "Real rows per coalesced forward (bucket fill)")
+        fail_c = reg.counter(
+            "serving_batch_failures_total",
+            "Coalesced forwards that raised or returned non-finite "
+            "outputs")
 
         def _on_shed(req, reason, _name=name):
             shed_c.labels(model=_name, reason=reason).inc()
 
-        def _on_batch(reqs, rows, bucket, dur_s, _name=name):
+        def _on_batch(reqs, rows, bucket, dur_s, _name=name,
+                      _entry=entry, _breaker=breaker):
             fwd_c.labels(model=_name).inc()
             rows_c.labels(model=_name).inc(rows)
             fill_h.labels(model=_name).observe(rows)
+            _breaker.record_success()
+            if _entry.golden_batch is None and reqs:
+                # Retain a slice of real traffic as the swap canary
+                # input (first served request, at most 4 rows).
+                _entry.golden_batch = np.asarray(reqs[0].x[:4]).copy()
+
+        def _on_batch_error(exc, n_requests, _name=name, _breaker=breaker):
+            fail_c.labels(model=_name).inc()
+            _breaker.record_failure(
+                trip=isinstance(exc, NonFiniteOutputError))
 
         engine.on_shed = _on_shed
         engine.on_batch = _on_batch
+        engine.on_batch_error = _on_batch_error
         with self._lock:
             if name in self._entries:
                 raise ValueError(f"model {name!r} already registered")
@@ -223,8 +310,10 @@ class ModelPool:
             # Decode + device-stage OUTSIDE the execution lock: traffic
             # keeps flowing while the npz trees are read. The live trees
             # are the templates, so a config/architecture drift fails
-            # here — before anything was mutated.
+            # here — before anything was mutated. (Chaos seam:
+            # "serve.decode" exercises exactly this pre-mutation path.)
             try:
+                faults.fire("serve.decode")
                 meta = validate_checkpoint(path)
                 with zipfile.ZipFile(path, "r") as zf:
                     new_params = _npz_bytes_to_tree(
@@ -233,7 +322,8 @@ class ModelPool:
                     new_state = _npz_bytes_to_tree(
                         _read_entry(zf, path, STATE_ENTRY),
                         model.state_tree)
-            except (CheckpointCorruptError, ValueError) as e:
+            except (CheckpointCorruptError, ValueError,
+                    faults.FaultInjected) as e:
                 _swap_counter(name, "failed")
                 raise SwapError(
                     f"checkpoint {rec.get('file')!r} cannot serve model "
@@ -241,7 +331,18 @@ class ModelPool:
             old = (model.params_tree, model.state_tree,
                    int(model.iteration), int(model.epoch))
             buckets = list(entry.engine.warmed_buckets) or [1]
+            golden = entry.golden_batch
             with entry.engine.paused():
+                old_out = None
+                if golden is not None:
+                    # The canary reference: OLD params' outputs on the
+                    # retained golden batch, computed inside the pause
+                    # window so no concurrent forward interleaves.
+                    try:
+                        old_out = _golden_forward(model, golden)
+                    except Exception:
+                        old_out = None  # old model already broken:
+                        # canary degrades to the finiteness check
                 model.params_tree = new_params
                 model.state_tree = new_state
                 model.iteration = int(meta.get("iteration", old[2]))
@@ -253,16 +354,43 @@ class ModelPool:
                     # executables (warmup() re-precompile is a no-op per
                     # stored signature: zero compile events).
                     for b in buckets:
+                        faults.fire("swap.warm")
                         model.warmup(b, time_steps=time_steps)
+                    # Canary gate: the new params must produce all-finite
+                    # outputs on the golden batch (and, with
+                    # canary_max_drift set, stay within the drift budget
+                    # of the old outputs) BEFORE traffic resumes.
+                    if golden is not None:
+                        new_out = _golden_forward(model, golden)
+                        if not np.isfinite(new_out).all():
+                            raise _CanaryRejected(
+                                "non-finite outputs on the golden batch")
+                        drift_cap = entry.canary_max_drift
+                        if (drift_cap is not None and old_out is not None
+                                and np.isfinite(old_out).all()):
+                            drift = float(np.max(np.abs(
+                                new_out - old_out))) if new_out.size else 0.0
+                            if drift > drift_cap:
+                                raise _CanaryRejected(
+                                    f"golden-batch output drift {drift:.6g} "
+                                    f"exceeds canary_max_drift {drift_cap}")
                 except Exception as e:
+                    # Auto-rollback: restore the OLD tree references
+                    # (bitwise the pre-swap params) before the pause
+                    # lock releases — traffic never sees the rejected
+                    # checkpoint.
                     (model.params_tree, model.state_tree,
                      model.iteration, model.epoch) = old
                     if hasattr(model, "_rnn_carry"):
                         model._rnn_carry = None
-                    _swap_counter(name, "failed")
+                    canary = isinstance(e, _CanaryRejected)
+                    _swap_counter(
+                        name, "canary_rejected" if canary else "failed")
+                    what = ("canary gate rejected"
+                            if canary else "warm forward failed on")
                     raise SwapError(
-                        f"warm forward failed on {rec.get('file')!r}; "
-                        f"rolled back to previous params: {e}") from e
+                        f"{what} {rec.get('file')!r}; rolled back to "
+                        f"previous params: {e}") from e
         with self._lock:
             entry.version = dict(rec)
             entry.swaps += 1

@@ -47,3 +47,10 @@ JAX_PLATFORMS=cpu python tests/smoke_resilience.py
 # new checkpoint, ZERO XLA compiles after warmup, and the serving
 # metric families on the scrape surface.
 JAX_PLATFORMS=cpu python tests/smoke_serving.py
+
+# Serving chaos smoke (docs/serving.md §resilience): same gateway under
+# a deterministic 20% serve.forward failure storm with an aggressive
+# circuit breaker — every response typed (ok / batch_failed /
+# breaker_open / shed), the breaker opens and recovers, zero compiles
+# after warmup, zero hung requests (hard in-process alarm).
+JAX_PLATFORMS=cpu python tests/smoke_chaos_serving.py

@@ -1,0 +1,206 @@
+"""Serving chaos smoke: live-traffic resilience acceptance check
+(docs/serving.md, docs/robustness.md).
+
+Builds a tiny warmed MLP gateway with an aggressive circuit breaker
+(threshold 1, 50 ms cooldown), arms the ``serve.forward`` fault point
+with ``fail:2/5`` (a deterministic 20% forward-failure rate), and
+drives concurrent HTTP /predict traffic through the storm. Asserts:
+
+* EVERY response is a typed terminal status — 200 ok, 500
+  batch_failed, 503 breaker_open, 503 shed, or 429 queue_full; never a
+  hang, never an untyped 5xx,
+* the breaker opened at least once under the storm and RECOVERS after
+  the faults are cleared (final /predict is 200, /health back to ok),
+* ZERO XLA compile events after warmup (chaos rides the AOT
+  executables too),
+* the Prometheus scrape carries the resilience metric families.
+
+A hard wall-clock alarm guards the whole run: a wedged future or hung
+collector fails the smoke instead of hanging CI.
+
+Run by runtests.sh as a separate step (no test_ prefix on purpose).
+Usage: JAX_PLATFORMS=cpu python tests/smoke_chaos_serving.py
+"""
+import json
+import os
+import signal
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deeplearning4j_tpu import (Adam, DenseLayer, InputType,  # noqa: E402
+                                MultiLayerNetwork, NeuralNetConfiguration,
+                                OutputLayer, WeightInit)
+from deeplearning4j_tpu.optimize.metrics import registry  # noqa: E402
+from deeplearning4j_tpu.optimize.telemetry import CompilationTracker  # noqa: E402
+from deeplearning4j_tpu.serving import ServingGateway  # noqa: E402
+from deeplearning4j_tpu.utils import faults  # noqa: E402
+
+HARD_TIMEOUT_S = 120
+FAULT_SPEC = "fail:2/5"  # forwards 2, 7, 12, ... fail: deterministic 20%
+
+REQUIRED_FAMILIES = (
+    "serving_requests_total", "serving_batch_failures_total",
+    "serving_breaker_state", "serving_breaker_transitions_total",
+    "serving_shed_total", "serving_queue_depth",
+)
+
+# (code, status, reason) triples a chaos request may legally end with.
+TYPED_OUTCOMES = {
+    (200, "ok", None),
+    (500, "error", "batch_failed"),
+    (500, "error", "nonfinite"),
+    (503, "unavailable", "breaker_open"),
+    (503, "shed", "deadline"),
+    (429, "shed", "queue_full"),
+}
+
+
+def _alarm(_sig, _frm):
+    print(f"SMOKE FAIL: hard timeout ({HARD_TIMEOUT_S}s) — a request or "
+          "the collector hung under chaos", file=sys.stderr)
+    os._exit(2)
+
+
+def make_net(seed=42):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(Adam(learning_rate=0.05))
+            .weight_init(WeightInit.XAVIER).list()
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def post(url, payload):
+    req = urllib.request.Request(
+        url, json.dumps(payload).encode(),
+        {"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def main() -> int:
+    signal.signal(signal.SIGALRM, _alarm)
+    signal.alarm(HARD_TIMEOUT_S)
+    failures = []
+
+    gw = ServingGateway()
+    # threshold 1 + 50ms cooldown: every injected failure opens the
+    # breaker, every cooldown probes — the full state machine cycles
+    # many times within one short storm.
+    gw.add_model("default", make_net(), batch_limit=8, queue_limit=64,
+                 breaker_threshold=1, breaker_reset_s=0.05)
+    gw.warmup()  # AOT: every pow2 bucket precompiled up front
+    entry = gw.pool.get("default")
+    open0 = registry().counter(
+        "serving_breaker_transitions_total", "").value(
+        model="default", to="open")
+
+    outcomes, errors = [], []
+
+    def client(i):
+        # 5-row requests: two can never share the 8-row warmed cap, so
+        # every coalesced batch is one request and an injected failure
+        # surfaces typed to its caller (not healed by retry-alone).
+        x = np.random.default_rng(i).standard_normal(
+            (5, 4)).astype(np.float32)
+        try:
+            for _ in range(10):
+                code, body = post(gw.url + "/predict",
+                                  {"features": x.tolist()})
+                outcomes.append((code, body.get("status"),
+                                 body.get("reason")))
+                if (code, body.get("status")) == (503, "unavailable"):
+                    time.sleep(0.01)  # give the breaker its cooldown
+        except Exception as e:  # transport-level breakage = smoke fail
+            errors.append(e)
+
+    faults.inject("serve.forward", FAULT_SPEC)
+    with gw, CompilationTracker() as trk:
+        ts = [threading.Thread(target=client, args=(i,))
+              for i in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=90)
+        hung = sum(t.is_alive() for t in ts)
+        if hung:
+            failures.append(f"{hung} client thread(s) hung under chaos")
+
+        # ---- recovery: clear the chaos, wait out one cooldown, and the
+        # gateway must serve cleanly and report healthy again.
+        faults.clear("serve.forward")
+        time.sleep(0.1)
+        probe = np.random.default_rng(99).standard_normal(
+            (2, 4)).astype(np.float32)
+        code, body = post(gw.url + "/predict",
+                          {"features": probe.tolist()})
+        if (code, body.get("status")) != (200, "ok"):
+            failures.append(f"post-chaos predict not 200/ok: {code} {body}")
+        with urllib.request.urlopen(gw.url + "/health") as r:
+            health = json.loads(r.read())
+        if health.get("status") != "ok" or health.get("degraded"):
+            failures.append(f"/health not back to ok after the storm: "
+                            f"{health}")
+        with urllib.request.urlopen(gw.url + "/metrics") as r:
+            metrics_text = r.read().decode()
+
+    if errors:
+        failures.append(f"{len(errors)} client(s) hit transport errors: "
+                        f"{errors[:3]}")
+    untyped = [o for o in outcomes if o not in TYPED_OUTCOMES]
+    if untyped:
+        failures.append(f"{len(untyped)} response(s) outside the typed "
+                        f"outcome set: {untyped[:5]}")
+    n_ok = sum(1 for o in outcomes if o[0] == 200)
+    n_failed = sum(1 for o in outcomes if o[2] == "batch_failed")
+    n_breaker = sum(1 for o in outcomes if o[2] == "breaker_open")
+    if len(outcomes) != 8 * 10:
+        failures.append(f"only {len(outcomes)}/80 requests terminated")
+    if n_ok == 0:
+        failures.append("no request succeeded during the storm")
+    if n_failed == 0:
+        failures.append("no request saw a typed batch_failed under a "
+                        "20% injected failure rate")
+    opened = registry().counter(
+        "serving_breaker_transitions_total", "").value(
+        model="default", to="open") - open0
+    if opened < 1:
+        failures.append("breaker never opened under the storm")
+    if entry.breaker.state != "closed":
+        failures.append(f"breaker did not recover: {entry.breaker.state}")
+    if entry.engine.total_batch_failures == 0:
+        failures.append("engine counted zero batch failures")
+    if trk.count != 0:
+        failures.append(f"{trk.count} XLA compile(s) after warmup — "
+                        "chaos must ride the AOT executables")
+    for fam in REQUIRED_FAMILIES:
+        if fam not in metrics_text:
+            failures.append(f"metric family {fam} missing from /metrics")
+
+    signal.alarm(0)
+    if failures:
+        for f in failures:
+            print(f"SMOKE FAIL: {f}", file=sys.stderr)
+        return 1
+    print(f"serving chaos smoke OK: {len(outcomes)} requests all typed "
+          f"({n_ok} ok / {n_failed} batch_failed / {n_breaker} "
+          f"breaker_open), breaker opened {int(opened)}x and recovered, "
+          f"0 compiles after warmup, all {len(REQUIRED_FAMILIES)} "
+          "resilience families scraped")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

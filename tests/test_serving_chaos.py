@@ -1,0 +1,667 @@
+"""Serving resilience chaos suite (docs/serving.md, docs/robustness.md).
+
+Covers the PR-8 tentpole legs: batch-failure isolation in the
+continuous-batching collector (a poisoned request fails alone with a
+typed error and never strands a caller or kills the engine), the
+per-model circuit breaker (trip, half-open probe, recovery, fast-fail
+status), the canary-gated hot-swap with auto-rollback (a checkpoint
+that passes its sha256 gate but computes garbage never reaches
+traffic), and the new fault-grammar satellites (``delay:`` latency
+injection, ``N/M`` periodic selectors, serving fault points, env
+arming).
+
+Device work per test is deliberately tiny (stub models or the shared
+4->16->3 MLP on CPU); the concurrent chaos storm is `slow`.
+"""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.optimize.metrics import registry
+from deeplearning4j_tpu.optimize.resilience import CheckpointManager
+from deeplearning4j_tpu.parallel.inference import (BatchExecutionError,
+                                                   NonFiniteOutputError,
+                                                   ParallelInference)
+from deeplearning4j_tpu.serving import (BreakerOpenError, CircuitBreaker,
+                                        ServingGateway, SwapError)
+from deeplearning4j_tpu.serving.breaker import CLOSED, HALF_OPEN, OPEN
+from deeplearning4j_tpu.utils import faults
+
+from test_serving_gateway import make_net, post_json, rand_x
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def param_leaves(net):
+    import jax
+    return [np.asarray(a).copy()
+            for a in jax.tree_util.tree_leaves(net.params_tree)]
+
+
+def assert_leaves_equal(a, b):
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+class _ChaosStub:
+    """Forward stand-in with switchable failure modes: `fail` raises,
+    `nan` poisons the output, rows containing `POISON` raise (the
+    poisoned-request case — its batchmates are clean)."""
+
+    _initialized = True
+    POISON = 777.0
+
+    def __init__(self, gate=None):
+        self.gate = gate          # threading.Event the forward waits on
+        self.calls = 0
+        self.fail = False
+        self.nan = False
+
+    def output(self, x):
+        if self.gate is not None:
+            self.gate.wait(timeout=10)
+        self.calls += 1
+        x = np.asarray(x)
+        if self.fail:
+            raise RuntimeError("injected model failure")
+        if np.any(x == self.POISON):
+            raise RuntimeError("poisoned request rows")
+        out = x * 2.0
+        if self.nan:
+            out = out + np.nan
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Satellite: fault grammar — delay action, periodic selectors, env arming
+# ---------------------------------------------------------------------------
+class TestFaultGrammar:
+    def test_periodic_selector_covers_every_mth_from_nth(self):
+        plan = faults._parse("fail:2/3")
+        hits = [n for n in range(1, 12) if plan.covers(n)]
+        assert hits == [2, 5, 8, 11]
+
+    def test_periodic_mixes_with_plain_selectors(self):
+        plan = faults._parse("fail:1,4/10")
+        assert [n for n in range(1, 30) if plan.covers(n)] == [1, 4, 14, 24]
+
+    def test_delay_parses_selector_and_ms(self):
+        plan = faults._parse("delay:1/4@25")
+        assert plan.action == "delay"
+        assert plan.delay_ms == 25.0
+        assert plan.covers(1) and plan.covers(5) and not plan.covers(2)
+
+    @pytest.mark.parametrize("bad", [
+        "delay:2",            # no @MS
+        "delay:*@-5",         # negative sleep
+        "delay:*@oops",       # non-numeric sleep
+        "fail:0/5",           # selectors are 1-based
+        "fail:2/0",           # zero period
+        "jitter:*",           # unknown action
+    ])
+    def test_bad_specs_raise(self, bad):
+        with pytest.raises(ValueError):
+            faults._parse(bad)
+
+    def test_fire_delay_sleeps_then_continues(self):
+        faults.inject("t.delay", "delay:1@40")
+        t0 = time.perf_counter()
+        faults.fire("t.delay")                 # call 1: covered, sleeps
+        slept = time.perf_counter() - t0
+        assert slept >= 0.03, slept
+        t0 = time.perf_counter()
+        faults.fire("t.delay")                 # call 2: no-op
+        assert time.perf_counter() - t0 < 0.02
+        assert faults.fired_count("t.delay") == 1
+
+    def test_check_delay_sleeps_but_stays_false(self):
+        faults.inject("t.flag", "delay:*@10")
+        assert faults.check("t.flag") is False  # slowed, not flipped
+
+    def test_env_arms_serve_forward(self):
+        var = faults._env_var("serve.forward")
+        assert var == "DL4JTPU_FAULT_SERVE_FORWARD"
+        os.environ[var] = "fail:1"
+        try:
+            faults.reset()                      # allow env re-arm
+            pi = ParallelInference(_ChaosStub(), batch_timeout_ms=0.5)
+            try:
+                with pytest.raises(BatchExecutionError) as ei:
+                    pi.output(rand_x(1))
+                assert isinstance(ei.value.__cause__, faults.FaultInjected)
+                out = pi.output(rand_x(1))      # call 2: healthy again
+                assert out.shape == (1, 4)
+            finally:
+                pi.shutdown()
+        finally:
+            del os.environ[var]
+            faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# Tentpole: batch-failure isolation in the collector
+# ---------------------------------------------------------------------------
+class TestBatchFailureIsolation:
+    def test_poisoned_request_fails_alone_batchmates_survive(self):
+        gate = threading.Event()
+        stub = _ChaosStub(gate=gate)
+        pi = ParallelInference(stub, batch_limit=8, batch_timeout_ms=0.0,
+                               queue_limit=16)
+        results, errors = {}, {}
+        done = []
+
+        def call(key, x):
+            try:
+                results[key] = pi.output(x)
+            except Exception as e:
+                errors[key] = e
+            finally:
+                done.append(key)
+
+        poison = np.full((1, 4), _ChaosStub.POISON, np.float32)
+        try:
+            # First request wedges the collector on the gate; the poison
+            # and two clean requests queue behind it and coalesce.
+            ts = [threading.Thread(target=call, args=("warm", rand_x(1)))]
+            ts[0].start()
+            time.sleep(0.05)
+            ts += [threading.Thread(target=call, args=("poison", poison)),
+                   threading.Thread(target=call, args=("good1", rand_x(1, 1))),
+                   threading.Thread(target=call, args=("good2", rand_x(2, 2)))]
+            for t in ts[1:]:
+                t.start()
+            time.sleep(0.05)
+            gate.set()
+            for t in ts:
+                t.join(timeout=10)
+            assert sorted(done) == ["good1", "good2", "poison", "warm"], \
+                "a caller hung"
+            # only the poisoned request failed, with the typed wrapper
+            assert set(errors) == {"poison"}
+            assert isinstance(errors["poison"], BatchExecutionError)
+            assert isinstance(errors["poison"].__cause__, RuntimeError)
+            np.testing.assert_array_equal(results["good1"],
+                                          rand_x(1, 1) * 2.0)
+            np.testing.assert_array_equal(results["good2"],
+                                          rand_x(2, 2) * 2.0)
+            # the engine survived: later traffic is served normally
+            np.testing.assert_array_equal(pi.output(rand_x(3, 3)),
+                                          rand_x(3, 3) * 2.0)
+            assert pi.total_batch_failures >= 1
+        finally:
+            gate.set()
+            pi.shutdown()
+
+    def test_on_batch_error_hook_sees_each_failed_attempt(self):
+        stub = _ChaosStub()
+        pi = ParallelInference(stub, batch_timeout_ms=0.5)
+        seen = []
+        pi.on_batch_error = lambda exc, n: seen.append((type(exc), n))
+        try:
+            stub.fail = True
+            with pytest.raises(BatchExecutionError):
+                pi.output(rand_x(1))
+            assert seen and seen[0][0] is BatchExecutionError
+        finally:
+            pi.shutdown()
+
+    def test_check_finite_flags_nan_outputs(self):
+        stub = _ChaosStub()
+        stub.nan = True
+        pi = ParallelInference(stub, batch_timeout_ms=0.5,
+                               check_finite=True)
+        try:
+            with pytest.raises(NonFiniteOutputError):
+                pi.output(rand_x(1))
+            assert pi.total_batch_failures == 1
+        finally:
+            pi.shutdown()
+
+    def test_check_finite_off_lets_nan_through(self):
+        stub = _ChaosStub()
+        stub.nan = True
+        pi = ParallelInference(stub, batch_timeout_ms=0.5)
+        try:
+            out = pi.output(rand_x(1))
+            assert np.isnan(out).all()
+        finally:
+            pi.shutdown()
+
+    def test_builder_passes_check_finite(self):
+        pi = (ParallelInference.builder(_ChaosStub())
+              .check_finite().build())
+        try:
+            assert pi.check_finite is True
+        finally:
+            pi.shutdown()
+
+    def test_sequential_mode_wraps_failures_too(self):
+        from deeplearning4j_tpu.parallel.inference import InferenceMode
+        stub = _ChaosStub()
+        stub.fail = True
+        pi = ParallelInference(stub,
+                               inference_mode=InferenceMode.SEQUENTIAL)
+        with pytest.raises(BatchExecutionError):
+            pi.output(rand_x(1))
+        stub.fail = False
+        stub.nan = True
+        pi.check_finite = True
+        with pytest.raises(NonFiniteOutputError):
+            pi.output(rand_x(1))
+        pi.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Tentpole: circuit breaker state machine (fake clock — no sleeps)
+# ---------------------------------------------------------------------------
+class TestCircuitBreaker:
+    def make(self, **kw):
+        self.now = [0.0]
+        kw.setdefault("failure_threshold", 3)
+        kw.setdefault("reset_timeout_s", 10.0)
+        return CircuitBreaker("cbtest", clock=lambda: self.now[0], **kw)
+
+    def test_opens_after_consecutive_failures_only(self):
+        br = self.make()
+        br.record_failure()
+        br.record_failure()
+        br.record_success()                    # run broken: back to zero
+        assert br.consecutive_failures == 0
+        for _ in range(2):
+            br.record_failure()
+        assert br.state == CLOSED
+        br.record_failure()
+        assert br.state == OPEN
+        assert not br.allow()
+
+    def test_nonfinite_trip_opens_immediately(self):
+        br = self.make(failure_threshold=5)
+        br.record_failure(trip=True)
+        assert br.state == OPEN
+
+    def test_cooldown_then_half_open_single_probe(self):
+        br = self.make(reset_timeout_s=10.0)
+        br.record_failure(trip=True)
+        self.now[0] = 9.0
+        assert not br.allow()                  # still cooling down
+        self.now[0] = 10.5
+        assert br.allow()                      # the probe
+        assert br.state == HALF_OPEN
+        assert not br.allow()                  # one probe at a time
+        br.record_success()
+        assert br.state == CLOSED
+        assert br.allow()
+
+    def test_probe_failure_reopens_with_fresh_cooldown(self):
+        br = self.make(reset_timeout_s=10.0)
+        br.record_failure(trip=True)
+        self.now[0] = 11.0
+        assert br.allow()
+        br.record_failure()
+        assert br.state == OPEN
+        self.now[0] = 20.0                     # 9s into the NEW cooldown
+        assert not br.allow()
+        self.now[0] = 21.5
+        assert br.allow()
+
+    def test_stuck_probe_slot_released_after_probe_timeout(self):
+        br = self.make(reset_timeout_s=10.0, probe_timeout_s=2.0)
+        br.record_failure(trip=True)
+        self.now[0] = 11.0
+        assert br.allow()                      # probe that will vanish
+        assert not br.allow()                  # slot taken
+        self.now[0] = 13.5                     # probe_timeout_s elapsed
+        assert br.allow()                      # breaker never wedges
+
+    def test_straggler_failure_while_open_is_ignored(self):
+        br = self.make(failure_threshold=1)
+        br.record_failure()
+        assert br.state == OPEN
+        trans0 = registry().counter(
+            "serving_breaker_transitions_total", "").total()
+        br.record_failure()                    # in-flight straggler
+        assert br.state == OPEN
+        assert registry().counter(
+            "serving_breaker_transitions_total", "").total() == trans0
+
+    def test_metrics_gauge_and_transitions(self):
+        g = registry().gauge("serving_breaker_state", "")
+        br = CircuitBreaker("cbmetrics", failure_threshold=1,
+                            reset_timeout_s=0.0)
+        assert g.value(model="cbmetrics") == 0
+        br.record_failure()
+        assert g.value(model="cbmetrics") == 1
+        assert br.allow()                      # 0s cooldown: straight probe
+        assert g.value(model="cbmetrics") == 2
+        br.record_success()
+        assert g.value(model="cbmetrics") == 0
+        c = registry().counter("serving_breaker_transitions_total", "")
+        assert c.value(model="cbmetrics", to="open") == 1
+        assert c.value(model="cbmetrics", to="half_open") == 1
+        assert c.value(model="cbmetrics", to="closed") == 1
+
+    def test_threshold_validation(self):
+        with pytest.raises(ValueError):
+            CircuitBreaker("bad", failure_threshold=0)
+
+
+# ---------------------------------------------------------------------------
+# Breaker wired through the gateway (in-process + HTTP)
+# ---------------------------------------------------------------------------
+class TestGatewayBreaker:
+    def test_fast_fail_skips_forward_and_recovers(self):
+        stub = _ChaosStub()
+        gw = ServingGateway()
+        gw.add_model("m", stub, breaker_threshold=2, breaker_reset_s=0.05,
+                     batch_timeout_ms=0.5)
+        entry = gw.pool.get("m")
+        c0 = registry().counter("serving_requests_total", "").value(
+            model="m", status="breaker_open")
+        f0 = registry().counter("serving_batch_failures_total", "").value(
+            model="m")
+        try:
+            stub.fail = True
+            for _ in range(2):
+                with pytest.raises(BatchExecutionError):
+                    gw.predict("m", rand_x(1))
+            assert entry.breaker.state == OPEN
+            calls = stub.calls
+            with pytest.raises(BreakerOpenError):
+                gw.predict("m", rand_x(1))
+            assert stub.calls == calls, "fast-fail must not forward"
+            assert registry().counter("serving_requests_total", "").value(
+                model="m", status="breaker_open") == c0 + 1
+            assert registry().counter(
+                "serving_batch_failures_total", "").value(model="m") \
+                == f0 + 2
+            # cooldown -> half-open probe succeeds -> closed again
+            stub.fail = False
+            time.sleep(0.06)
+            out = gw.predict("m", rand_x(1))
+            assert out.shape == (1, 4)
+            assert entry.breaker.state == CLOSED
+        finally:
+            gw.pool.shutdown()
+
+    def test_nonfinite_output_trips_instantly(self):
+        stub = _ChaosStub()
+        gw = ServingGateway()
+        gw.add_model("m", stub, breaker_threshold=50, breaker_reset_s=30.0,
+                     batch_timeout_ms=0.5)
+        try:
+            stub.nan = True
+            with pytest.raises(NonFiniteOutputError):
+                gw.predict("m", rand_x(1))
+            assert gw.pool.get("m").breaker.state == OPEN  # one strike
+        finally:
+            gw.pool.shutdown()
+
+    def test_http_statuses_and_degraded_health(self):
+        stub = _ChaosStub()
+        gw = ServingGateway()
+        gw.add_model("m", stub, breaker_threshold=1, breaker_reset_s=0.05,
+                     batch_timeout_ms=0.5)
+        with gw:
+            x = rand_x(1).tolist()
+            code, body = post_json(gw.url + "/health", {})  # GET-only route
+            stub.fail = True
+            code, body = post_json(gw.url + "/predict",
+                                   {"model": "m", "features": x})
+            assert (code, body["status"], body["reason"]) == \
+                (500, "error", "batch_failed")
+            code, body = post_json(gw.url + "/predict",
+                                   {"model": "m", "features": x})
+            assert (code, body["status"], body["reason"]) == \
+                (503, "unavailable", "breaker_open")
+            import json
+            import urllib.request
+            with urllib.request.urlopen(gw.url + "/health") as r:
+                health = json.loads(r.read())
+            assert health["status"] == "degraded"
+            assert health["degraded"] == ["m"]
+            assert health["breakers"]["m"] == "open"
+            # recover: cooldown, healthy probe, health back to ok
+            stub.fail = False
+            time.sleep(0.06)
+            code, body = post_json(gw.url + "/predict",
+                                   {"model": "m", "features": x})
+            assert (code, body["status"]) == (200, "ok")
+            with urllib.request.urlopen(gw.url + "/health") as r:
+                health = json.loads(r.read())
+            assert health["status"] == "ok"
+            assert health["breakers"]["m"] == "closed"
+
+    def test_nonfinite_maps_to_500_nonfinite(self):
+        stub = _ChaosStub()
+        gw = ServingGateway()
+        gw.add_model("m", stub, breaker_threshold=50,
+                     batch_timeout_ms=0.5)
+        with gw:
+            stub.nan = True
+            code, body = post_json(gw.url + "/predict",
+                                   {"model": "m",
+                                    "features": rand_x(1).tolist()})
+            assert (code, body["reason"]) == (500, "nonfinite")
+
+    def test_breaker_state_in_scrape_and_describe(self):
+        stub = _ChaosStub()
+        gw = ServingGateway()
+        gw.add_model("scrapem", stub, breaker_threshold=1,
+                     batch_timeout_ms=0.5)
+        try:
+            stub.fail = True
+            with pytest.raises(BatchExecutionError):
+                gw.predict("scrapem", rand_x(1))
+            text = registry().prometheus_text()
+            assert 'serving_breaker_state{model="scrapem"} 1' in text
+            assert "serving_breaker_transitions_total" in text
+            assert "serving_batch_failures_total" in text
+            desc = gw.pool.get("scrapem").describe()
+            assert desc["breaker"]["state"] == "open"
+            assert desc["total_batch_failures"] == 1
+        finally:
+            gw.pool.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Tentpole: canary-gated hot-swap with auto-rollback
+# ---------------------------------------------------------------------------
+class TestCanaryGate:
+    def _nan_donor(self):
+        import jax
+        donor = make_net(seed=5, train_seed=5)
+        leaves, treedef = jax.tree_util.tree_flatten(donor.params_tree)
+        leaves[0] = np.asarray(leaves[0]) * np.nan
+        donor.params_tree = jax.tree_util.tree_unflatten(treedef, leaves)
+        return donor
+
+    def test_nan_checkpoint_rejected_and_rolled_back(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        mgr.save(self._nan_donor())            # passes the sha256 gate!
+        net = make_net(seed=42)
+        gw = ServingGateway()
+        gw.add_model("m", net, checkpoints=mgr, batch_limit=4,
+                     golden_batch=rand_x(2, seed=9))
+        c0 = registry().counter("serving_swaps_total", "").value(
+            model="m", outcome="canary_rejected")
+        before = param_leaves(net)
+        ref = net.output(rand_x(2, seed=9))
+        try:
+            with pytest.raises(SwapError, match="canary gate rejected"):
+                gw.swap("m")
+            assert registry().counter("serving_swaps_total", "").value(
+                model="m", outcome="canary_rejected") == c0 + 1
+            # bitwise rollback: every param leaf equals pre-swap bytes
+            assert_leaves_equal(param_leaves(net), before)
+            # and the OLD params are still the ones serving
+            np.testing.assert_array_equal(
+                gw.predict("m", rand_x(2, seed=9)), ref)
+            assert gw.pool.get("m").version == {}  # never promoted
+        finally:
+            gw.pool.shutdown()
+
+    def test_drift_budget_rejects_then_admits(self, tmp_path):
+        donor = make_net(seed=42, train_seed=5)  # finite, different params
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        mgr.save(donor)
+        net = make_net(seed=42)
+        gw = ServingGateway()
+        gw.add_model("m", net, checkpoints=mgr,
+                     golden_batch=rand_x(2, seed=3),
+                     canary_max_drift=0.0)       # zero tolerance
+        entry = gw.pool.get("m")
+        before = param_leaves(net)
+        try:
+            with pytest.raises(SwapError, match="drift"):
+                gw.swap("m")
+            assert_leaves_equal(param_leaves(net), before)
+            entry.canary_max_drift = 1e6         # loosen the budget
+            assert gw.swap("m")["swapped"] is True
+            assert_leaves_equal(param_leaves(net), param_leaves(donor))
+        finally:
+            gw.pool.shutdown()
+
+    def test_golden_batch_captured_from_first_traffic(self):
+        net = make_net()
+        gw = ServingGateway()
+        gw.add_model("m", net, batch_limit=4)
+        entry = gw.pool.get("m")
+        try:
+            assert entry.golden_batch is None
+            x = rand_x(6, seed=4)
+            gw.predict("m", x)
+            deadline = time.monotonic() + 5     # on_batch runs in collector
+            while entry.golden_batch is None and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert entry.golden_batch is not None
+            assert entry.golden_batch.shape[0] <= 4  # bounded retention
+            np.testing.assert_array_equal(entry.golden_batch, x[:4])
+        finally:
+            gw.pool.shutdown()
+
+    def test_swap_warm_fault_rolls_back_as_failed(self, tmp_path):
+        donor = make_net(seed=42, train_seed=7)
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        mgr.save(donor)
+        net = make_net(seed=42)
+        gw = ServingGateway()
+        gw.add_model("m", net, checkpoints=mgr, batch_limit=4)
+        before = param_leaves(net)
+        f0 = registry().counter("serving_swaps_total", "").value(
+            model="m", outcome="failed")
+        try:
+            with faults.injected("swap.warm", "fail:1"):
+                with pytest.raises(SwapError, match="warm forward failed"):
+                    gw.swap("m")
+            assert registry().counter("serving_swaps_total", "").value(
+                model="m", outcome="failed") == f0 + 1
+            assert_leaves_equal(param_leaves(net), before)
+            # the chaos plan is exhausted: the retried swap goes through
+            assert gw.swap("m")["swapped"] is True
+        finally:
+            gw.pool.shutdown()
+
+    def test_serve_decode_fault_fails_before_any_mutation(self, tmp_path):
+        donor = make_net(seed=42, train_seed=8)
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        mgr.save(donor)
+        net = make_net(seed=42)
+        gw = ServingGateway()
+        gw.add_model("m", net, checkpoints=mgr, batch_limit=4)
+        tree_before = net.params_tree            # identity, not just bytes
+        try:
+            with faults.injected("serve.decode", "fail:1"):
+                with pytest.raises(SwapError, match="cannot serve"):
+                    gw.swap("m")
+            assert net.params_tree is tree_before  # never even paused
+        finally:
+            gw.pool.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Chaos storm: 20% injected forward failures under concurrent traffic
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+class TestChaosStorm:
+    def test_injected_failures_under_concurrency_zero_hangs(self):
+        """Acceptance-criteria storm: a real warmed MLP serving
+        concurrent clients while every 5th forward (from the 2nd) is
+        injection-failed. Every caller terminates with a typed outcome,
+        the breaker opens and recovers, and after the faults clear the
+        gateway serves normally."""
+        net = make_net(train_seed=0)
+        gw = ServingGateway()
+        gw.add_model("m", net, batch_limit=8, queue_limit=64,
+                     breaker_threshold=1, breaker_reset_s=0.05)
+        gw.warmup()
+        entry = gw.pool.get("m")
+        open0 = registry().counter(
+            "serving_breaker_transitions_total", "").value(
+            model="m", to="open")
+        outcomes = {"ok": 0, "batch_failed": 0, "breaker_open": 0,
+                    "shed": 0}
+        lock = threading.Lock()
+
+        def bump(k):
+            with lock:
+                outcomes[k] += 1
+
+        def client(i):
+            # 5-row requests: two can never share the 8-row warmed cap,
+            # so every coalesced batch is a SINGLE request and an
+            # injected forward failure surfaces to its caller typed
+            # (instead of being healed by the retry-alone isolation).
+            x = rand_x(5, seed=i)
+            for _ in range(25):
+                try:
+                    out = gw.predict("m", x)
+                    assert np.isfinite(out).all()
+                    bump("ok")
+                except BreakerOpenError:
+                    bump("breaker_open")
+                    time.sleep(0.01)
+                except BatchExecutionError:
+                    bump("batch_failed")
+                except Exception:
+                    bump("shed")
+
+        faults.inject("serve.forward", "fail:2/5")  # deterministic 20%
+        try:
+            ts = [threading.Thread(target=client, args=(i,))
+                  for i in range(6)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=60)
+            hung = [t for t in ts if t.is_alive()]
+            assert not hung, f"{len(hung)} client threads hung"
+            # every call landed in a typed bucket and both failure modes
+            # actually happened under the storm
+            assert sum(outcomes.values()) == 6 * 25, outcomes
+            assert outcomes["ok"] > 0, outcomes
+            assert outcomes["batch_failed"] > 0, outcomes
+            assert entry.engine.total_batch_failures > 0
+            # the breaker actually opened under the storm (threshold 1)
+            assert registry().counter(
+                "serving_breaker_transitions_total", "").value(
+                model="m", to="open") > open0
+            # recovery: clear the chaos, wait out the cooldown, and the
+            # gateway must serve cleanly again
+            faults.clear("serve.forward")
+            time.sleep(0.06)
+            for i in range(5):
+                out = gw.predict("m", rand_x(2, seed=100 + i))
+                assert np.isfinite(out).all()
+            assert entry.breaker.state == CLOSED
+        finally:
+            faults.clear("serve.forward")
+            gw.pool.shutdown()
