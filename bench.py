@@ -865,6 +865,11 @@ def main():
         # chaos counters ride every BENCH snapshot.
         from deeplearning4j_tpu.serving import breaker as serving_breaker
         serving_breaker.register_metrics()
+        # And the cluster-health families (peer beat-age/step-lag,
+        # desync/grace counters — docs/robustness.md §cluster-health):
+        # MULTICHIP snapshots always carry them, beats or no beats.
+        from deeplearning4j_tpu.parallel import cluster_health
+        cluster_health.register_metrics()
         with CompilationTracker() as trk:
             metric, ips, unit, extra = run_once(workload, arg)
         # XLA compilations the measurement triggered: warm-up should own

@@ -80,6 +80,14 @@ def _counter(name: str):
     return metrics_mod.registry().counter(name, _HELP[name])
 
 
+def counter(name: str):
+    """Public accessor for a resilience counter family (by `_HELP` name)
+    — lets sibling layers (e.g. the multihost StepCheckpointManager)
+    bump shared families like ``checkpoint_corrupt_total`` without
+    duplicating help strings."""
+    return _counter(name)
+
+
 # ---------------------------------------------------------------------------
 # Retry/backoff
 # ---------------------------------------------------------------------------

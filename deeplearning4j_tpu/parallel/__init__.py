@@ -4,9 +4,14 @@ Replaces the reference's entire deeplearning4j-scaleout tree (ParallelWrapper
 thread zoo, Spark parameter averaging, Aeron parameter server — SURVEY.md
 §2.4) with sharded jit over a jax.sharding.Mesh.
 """
+from .cluster_health import (BarrierTimeoutError, ClusterDesyncError,
+                             ClusterHealthError, ClusterHealthMonitor,
+                             GraceCheckpointed, HealthConfig, PeerLostError,
+                             timed_collective)
 from .inference import (DeadlineExceededError, InferenceMode,
                         ParallelInference, QueueFullError, ServerClosedError)
-from .multihost import CheckpointManager, MultiHostRunner
+from .multihost import (CheckpointManager, MultiHostRunner,
+                        StepCheckpointManager)
 from .mesh import (DATA_AXIS, MODEL_AXIS, SEQ_AXIS, batch_sharded,
                    create_mesh, data_parallel_mesh, replicate, replicated,
                    shard_batch)

@@ -36,23 +36,34 @@ from __future__ import annotations
 import collections
 import logging
 import os
+import signal
+import threading
 from typing import Optional
 
 import jax
 import numpy as np
 
+from . import cluster_health as health_lib
 from . import mesh as mesh_lib
+from .cluster_health import HealthConfig
 from .wrapper import ParallelWrapper
 
 log = logging.getLogger(__name__)
 
 
-class CheckpointManager:
+class StepCheckpointManager:
     """Step-numbered checkpoint directory with atomic writes and a
     retention bound — the substrate of the auto-resume story (the
     reference has no elastic recovery at all, SURVEY.md §5.3; this is
     deliberate beyond-parity scope: checkpoint-restart is the realistic
-    TPU preemption baseline)."""
+    TPU preemption baseline).
+
+    Distinct from :class:`deeplearning4j_tpu.optimize.resilience.\
+CheckpointManager` (manifest + sha256 + cadence/retention policy, the
+    single-process fit-loop integration): this one is the *multihost*
+    flavor — bare ``checkpoint_step<N>.zip`` files, chief-written under
+    cluster barriers (docs/robustness.md §cluster-health). The old
+    ``CheckpointManager`` name is kept as a deprecated alias."""
 
     PATTERN = "checkpoint_step%d.zip"
 
@@ -80,6 +91,28 @@ class CheckpointManager:
         entries = self._entries()
         return entries[-1] if entries else None
 
+    def latest_valid(self):
+        """(step, path) of the newest checkpoint that passes structural
+        validation. A torn newest file (e.g. a kill during a non-atomic
+        copy INTO the directory — the writer itself is atomic) must not
+        crash resume on every process: it is skipped with a warning and
+        a ``checkpoint_corrupt_total`` bump, falling back to the
+        next-newest — matching
+        ``optimize.resilience.CheckpointManager.latest_valid()``."""
+        from ..optimize import resilience
+        from ..utils.model_serializer import (CheckpointCorruptError,
+                                              validate_checkpoint)
+        for step, path in reversed(self._entries()):
+            try:
+                validate_checkpoint(path, deep=True)
+            except CheckpointCorruptError as e:
+                resilience.counter("checkpoint_corrupt_total").inc()
+                log.warning("skipping torn/corrupt checkpoint %s: %s",
+                            path, e)
+                continue
+            return step, path
+        return None
+
     def save(self, model, step: int) -> str:
         """Atomic write (tmp + rename — a killed writer can never leave
         a truncated 'latest' checkpoint) + retention prune."""
@@ -96,10 +129,11 @@ class CheckpointManager:
         return final
 
     def restore_into(self, model) -> Optional[int]:
-        """Load the newest checkpoint's trees INTO the caller's model
-        object (the restart path keeps its own net instance). Returns
-        the restored step, or None when no checkpoint exists."""
-        entry = self.latest()
+        """Load the newest *valid* checkpoint's trees INTO the caller's
+        model object (the restart path keeps its own net instance).
+        Returns the restored step, or None when no valid checkpoint
+        exists."""
+        entry = self.latest_valid()
         if entry is None:
             return None
         step, path = entry
@@ -118,11 +152,18 @@ class CheckpointManager:
         return step
 
 
+#: Deprecated alias (pre-round-9 name). It collided with
+#: ``optimize.resilience.CheckpointManager``; new code should import
+#: :class:`StepCheckpointManager`.
+CheckpointManager = StepCheckpointManager
+
+
 class MultiHostRunner:
     def __init__(self, coordinator_address: Optional[str] = None,
                  num_processes: Optional[int] = None,
                  process_id: Optional[int] = None,
-                 auto_detect: bool = False):
+                 auto_detect: bool = False,
+                 health: Optional[object] = None):
         self.coordinator_address = coordinator_address or \
             os.environ.get("JAX_COORDINATOR_ADDRESS")
         self.num_processes = num_processes if num_processes is not None else \
@@ -134,6 +175,19 @@ class MultiHostRunner:
         self.auto_detect = auto_detect
         self._initialized = False
         self._mesh = None
+        # Cluster health plane (docs/robustness.md §cluster-health):
+        # health=True/HealthConfig arms it explicitly; health=None defers
+        # to the DL4JTPU_HEARTBEAT env knob; health=False disables.
+        if health is False:
+            self.health_config: Optional[HealthConfig] = None
+        elif isinstance(health, HealthConfig):
+            self.health_config = health
+        elif health is True or health_lib.health_enabled_from_env():
+            self.health_config = HealthConfig.from_env()
+        else:
+            self.health_config = None
+        self._monitor: Optional[health_lib.ClusterHealthMonitor] = None
+        self.last_grace_step: Optional[int] = None
         # Bounded LRU: wrappers pin their models, so an unbounded cache
         # would leak every model ever fit (hyperparameter sweeps).
         self._wrappers = collections.OrderedDict()
@@ -191,6 +245,62 @@ class MultiHostRunner:
                 [jax.device_count()], (mesh_lib.DATA_AXIS,), jax.devices())
         return self._mesh
 
+    # -------------------------------------------------------- cluster health
+    def start_health(self, on_failure=None
+                     ) -> Optional[health_lib.ClusterHealthMonitor]:
+        """Start the heartbeat watchdog (idempotent; no-op when the
+        plane is disabled or the job is single-process). Process 0
+        hosts the beat channel at the coordinator host on
+        ``health_config.port`` (default: coordinator port + 1)."""
+        if self.health_config is None or jax.process_count() <= 1:
+            return None
+        if self._monitor is not None:
+            return self._monitor
+        host, port = self._beat_endpoint()
+        if host is None:
+            log.warning("cluster health enabled but no coordinator "
+                        "address/port to derive the beat channel from; "
+                        "set DL4JTPU_HEARTBEAT_PORT — watchdog disabled")
+            return None
+        transport = health_lib.HttpBeatTransport(
+            jax.process_index(), host, port, chief=self.is_chief)
+        self._monitor = health_lib.ClusterHealthMonitor(
+            jax.process_index(), jax.process_count(), transport,
+            config=self.health_config, on_failure=on_failure).start()
+        log.info("cluster health watchdog up: beat channel %s "
+                 "(interval %.1fs, timeout %.1fs)", transport.url,
+                 self.health_config.interval_s, self.health_config.timeout_s)
+        return self._monitor
+
+    def stop_health(self) -> None:
+        """Stop the watchdog thread and (on the chief) the beat server.
+        Call at orderly job shutdown so a fast-exiting chief is not
+        misread as lost by peers still finishing up."""
+        if self._monitor is not None:
+            self._monitor.stop()
+            self._monitor = None
+
+    def _beat_endpoint(self):
+        port = self.health_config.port if self.health_config else None
+        addr = self.coordinator_address
+        if addr and ":" in addr:
+            host, _, coord_port = addr.rpartition(":")
+            return host, (port if port else int(coord_port) + 1)
+        if addr and port:
+            return addr, port
+        return (None, None) if not port else ("127.0.0.1", port)
+
+    def _timed(self, fn, name: str):
+        """Run a blocking collective under the health plane's deadline
+        (pass-through when the plane is off): the known blocking points
+        fail typed instead of hanging forever."""
+        cfg = self.health_config
+        if cfg is None or not cfg.barrier_timeout_s:
+            return fn()
+        return health_lib.timed_collective(
+            fn, name=name, timeout_s=cfg.barrier_timeout_s,
+            monitor=self._monitor)
+
     # ------------------------------------------------------------- lockstep
     def _assert_lockstep(self, *values: int):
         """All processes must agree on loop bounds, or SPMD deadlocks
@@ -199,17 +309,30 @@ class MultiHostRunner:
             return
         from jax.experimental import multihost_utils
         mine = np.asarray(values, np.int64)
-        all_vals = multihost_utils.process_allgather(mine)
+        all_vals = self._timed(
+            lambda: multihost_utils.process_allgather(mine), "lockstep")
         if not (all_vals == all_vals[0]).all():
             raise ValueError(
                 f"Processes disagree on batch/epoch counts: {all_vals.tolist()}"
                 " — every process must feed identically-shaped local "
                 "partitions (repartition your data)")
 
-    def barrier(self, name: str = "barrier"):
-        if jax.process_count() > 1:
-            from jax.experimental import multihost_utils
-            multihost_utils.sync_global_devices(name)
+    def barrier(self, name: str = "barrier",
+                timeout_s: Optional[float] = None):
+        """Cluster barrier. With the health plane armed (or an explicit
+        `timeout_s`) the wait is bounded: expiry raises a typed
+        :class:`cluster_health.BarrierTimeoutError` (or the watchdog's
+        richer PeerLost/Desync diagnosis) instead of wedging forever."""
+        if jax.process_count() <= 1:
+            return
+        from jax.experimental import multihost_utils
+        fn = lambda: multihost_utils.sync_global_devices(name)  # noqa: E731
+        if timeout_s is not None:
+            health_lib.timed_collective(
+                fn, name=f"barrier:{name}", timeout_s=timeout_s,
+                monitor=self._monitor)
+        else:
+            self._timed(fn, f"barrier:{name}")
 
     # ------------------------------------------------------------------- fit
     def fit(self, model, local_features, local_labels=None, *,
@@ -229,7 +352,16 @@ class MultiHostRunner:
         checkpoint — already-trained steps are skipped by replaying the
         (deterministic) data order without stepping, so a preempted run
         reaches the same final parameters as an uninterrupted one
-        (tested by killing and restarting a 2-process gloo job)."""
+        (tested by killing and restarting a 2-process gloo job).
+
+        Cluster health (docs/robustness.md §cluster-health): with the
+        health plane armed (`health=`/`DL4JTPU_HEARTBEAT=1`), a
+        heartbeat watchdog runs for the duration of fit — a dead peer
+        raises a typed `PeerLostError` (and hard-exits, code 17) instead
+        of wedging this process at the next collective, and SIGTERM
+        triggers one coordinated grace checkpoint (barrier → chief save
+        → barrier) before a clean exit 0; the restart resumes
+        bitwise-identically through the replay-skip path above."""
         wrapper = self._wrapper_for(model, averaging_frequency)
         if hasattr(local_features, "num_examples"):     # DataSet
             n = local_features.num_examples()
@@ -244,13 +376,33 @@ class MultiHostRunner:
             self._assert_lockstep(n, batch_size, epochs)
         else:
             self._assert_lockstep(epochs)
+        monitor = self.start_health()
+        hook = None
+        if monitor is not None:
+            hook = monitor.notify_step
+            wrapper.step_hooks.append(hook)
+        try:
+            return self._fit_guarded(wrapper, model, local_features,
+                                     local_labels, epochs=epochs,
+                                     batch_size=batch_size,
+                                     checkpoint_dir=checkpoint_dir,
+                                     checkpoint_every=checkpoint_every,
+                                     resume=resume, monitor=monitor)
+        finally:
+            if hook is not None and hook in wrapper.step_hooks:
+                wrapper.step_hooks.remove(hook)
+
+    def _fit_guarded(self, wrapper, model, local_features, local_labels, *,
+                     epochs, batch_size, checkpoint_dir, checkpoint_every,
+                     resume, monitor):
         if checkpoint_dir is None:
             # Delegate the epoch/listener loop to the net's own fit (via
             # the wrapper) so loop semantics exist in exactly one place.
+            # No grace handler: there is nowhere to write the checkpoint.
             wrapper.fit(local_features, local_labels, epochs=epochs,
                         batch_size=batch_size)
             return wrapper
-        mgr = CheckpointManager(checkpoint_dir)
+        mgr = StepCheckpointManager(checkpoint_dir)
         skip = 0
         if resume:
             restored = mgr.restore_into(model)
@@ -278,8 +430,41 @@ class MultiHostRunner:
             return -(-T // L)
 
         remaining = [skip]
+        grace_flag = [False]    # set by the SIGTERM handler
+        calls = [0]
+        cfg = self.health_config
+        grace_every = max(1, int(cfg.grace_every)) if cfg else 1
+
+        def grace_poll() -> bool:
+            """Cluster-wide agreement on the preemption flag. Called at
+            the SAME cadence on every process (replay steps included) so
+            the allgather counts always match; any process's flag stops
+            the whole cluster at the same step, deterministically."""
+            local = grace_flag[0] or (monitor is not None
+                                      and monitor.grace_requested())
+            if jax.process_count() <= 1:
+                return local
+            from jax.experimental import multihost_utils
+            votes = multihost_utils.process_allgather(
+                np.asarray([1 if local else 0], np.int32))
+            return bool(np.asarray(votes).any())
+
+        def grace_checkpoint():
+            step = int(model.iteration)
+            log.info("preemption grace: coordinated checkpoint at step %d",
+                     step)
+            self.barrier("grace-pre-checkpoint")
+            if self.is_chief:
+                mgr.save(model, step)
+            self.barrier("grace-post-checkpoint")
+            health_lib._counter("cluster_grace_checkpoints_total").inc()
+            self.last_grace_step = step
+            raise health_lib.GraceCheckpointed(step)
 
         def elastic_step(ds):
+            calls[0] += 1
+            if calls[0] % grace_every == 0 and grace_poll():
+                grace_checkpoint()
             if remaining[0] > 0:
                 n = steps_in(ds)  # replay-skip: trained pre-restart
                 if n > remaining[0]:
@@ -291,6 +476,10 @@ class MultiHostRunner:
                 remaining[0] -= n
                 return
             wrapper.fit_batch(ds)
+            if monitor is not None:
+                # surface a recorded typed failure in the main thread
+                # too, while it is still alive to see it
+                monitor.check()
             if checkpoint_every and \
                     model.iteration % int(checkpoint_every) == 0:
                 self.barrier("pre-checkpoint")
@@ -298,9 +487,34 @@ class MultiHostRunner:
                     mgr.save(model, int(model.iteration))
                 self.barrier("post-checkpoint")
 
-        model.fit(local_features, local_labels, epochs=epochs,
-                  batch_size=batch_size, step_fn=elastic_step,
-                  use_async=False)
+        # SIGTERM → grace flag, checked at the next step boundary.
+        # signal.signal only works from the main thread; elsewhere (e.g.
+        # a fit driven from a server worker) grace still arms via a
+        # peer's flag riding the beat table.
+        prev_handler = None
+        installed = False
+        if threading.current_thread() is threading.main_thread():
+            def _on_sigterm(signum, frame):
+                grace_flag[0] = True
+                if monitor is not None:
+                    monitor.request_grace()
+            try:
+                prev_handler = signal.signal(signal.SIGTERM, _on_sigterm)
+                installed = True
+            except ValueError:   # exotic embeddings: no handler, no grace
+                pass
+        try:
+            model.fit(local_features, local_labels, epochs=epochs,
+                      batch_size=batch_size, step_fn=elastic_step,
+                      use_async=False)
+        except health_lib.GraceCheckpointed as g:
+            log.info("grace checkpoint written at step %d — exiting 0 "
+                     "for the restarter (resume=True picks it up)", g.step)
+            self.stop_health()
+            raise SystemExit(0)
+        finally:
+            if installed:
+                signal.signal(signal.SIGTERM, prev_handler)
         wrapper.finalize()
         return wrapper
 

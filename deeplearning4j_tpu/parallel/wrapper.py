@@ -78,6 +78,11 @@ class ParallelWrapper:
             raise ValueError("averaging_frequency must be >= 1")
         self.averaging_frequency = int(averaging_frequency)
         self.prefetch_buffer = prefetch_buffer
+        # Called with the model's iteration after every fit_batch — the
+        # cluster health plane wires its step-progress watchdog here
+        # (parallel/cluster_health.py), and it stays open for listeners
+        # that need the wrapper (not net) step granularity.
+        self.step_hooks = []
         self._warned_pad = False
         self._placed = False
         # ---- local-SGD (averaging_frequency > 1) machinery ----
@@ -205,6 +210,7 @@ class ParallelWrapper:
         net = self.model
         if self.averaging_frequency > 1:
             self._local_round(ds)
+            self._fire_step_hooks()
             return
         metrics_mod.registry().counter(
             "data_parallel_steps_total",
@@ -217,8 +223,20 @@ class ParallelWrapper:
             # reuse the graph's own dispatch (tBPTT windowing included)
             # with the sharded step substituted — the MLN do_step pattern
             net.fit_batch(net._coerce(ds), do_step=self._sync_graph_step)
+            self._fire_step_hooks()
             return
         net._fit_batch(ds, do_step=self._sync_step)
+        self._fire_step_hooks()
+
+    def _fire_step_hooks(self):
+        """Report the model's iteration to every registered hook. The
+        int() here reads a host-side counter (net.iteration is python),
+        so no device sync is added to the step path."""
+        if not self.step_hooks:
+            return
+        it = int(self.model.iteration)
+        for h in list(self.step_hooks):
+            h(it)
 
     def _sync_graph_step(self, inputs, labels, fm, lm):
         """Sharded analog of ComputationGraph._run_and_commit for one

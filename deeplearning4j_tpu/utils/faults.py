@@ -26,6 +26,16 @@ Points used by the training stack (arbitrary names are allowed):
     etl.next           each base-iterator poll in the async producer
     step.nonfinite     per-step divergence flag (checked, never raised)
 
+Points used by the cluster health plane (docs/robustness.md):
+
+    heartbeat.send     each watchdog beat publish — ``fail:`` suppresses
+                       the beat (a peer goes quiet), ``delay:SEL@MS``
+                       injects side-channel latency
+    step.stall         checked in ClusterHealthMonitor.notify_step; when
+                       armed the step report is swallowed, so the process
+                       keeps beating but looks frozen (the deterministic
+                       stand-in for a wedged main thread)
+
 Points used by the serving stack (docs/serving.md):
 
     serve.forward      each coalesced forward in ParallelInference (and

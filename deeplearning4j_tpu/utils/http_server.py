@@ -159,3 +159,17 @@ def _metrics_route():
     from ..optimize.metrics import registry
     body = registry().prometheus_text().encode()
     return 200, "text/plain; version=0.0.4; charset=utf-8", body
+
+
+def json_request(url: str, payload=None, timeout: float = 5.0):
+    """One-call JSON client for the in-repo servers: POST `payload` (GET
+    when None), parse the JSON reply. Always passes a socket timeout —
+    the callers (heartbeat transport, stats router, tests) must never
+    block forever on a half-dead peer. Raises urllib's errors on non-2xx
+    or timeout; the caller decides whether that is transient."""
+    import urllib.request
+    data = None if payload is None else json.dumps(payload).encode()
+    headers = {"Content-Type": "application/json"} if data else {}
+    req = urllib.request.Request(url, data=data, headers=headers)
+    with urllib.request.urlopen(req, timeout=float(timeout)) as r:
+        return json.loads(r.read().decode())

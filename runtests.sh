@@ -54,3 +54,10 @@ JAX_PLATFORMS=cpu python tests/smoke_serving.py
 # breaker_open / shed), the breaker opens and recovers, zero compiles
 # after warmup, zero hung requests (hard in-process alarm).
 JAX_PLATFORMS=cpu python tests/smoke_chaos_serving.py
+
+# Cluster-health smoke (docs/robustness.md §cluster-health): fake-clock
+# watchdog transitions (PeerLost/Desync), typed barrier timeout, and a
+# real SIGTERM'd child writing a grace checkpoint then resuming
+# bitwise-identically — under a hard signal.alarm so a watchdog
+# regression can never wedge the gate itself.
+JAX_PLATFORMS=cpu python tests/smoke_cluster_health.py

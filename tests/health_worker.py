@@ -1,0 +1,117 @@
+"""Worker for the cluster-health chaos tests (test_cluster_health_gloo.py).
+
+    python health_worker.py <pid> <nproc> <port> <ckpt_dir> <mode> <arg>
+
+Modes:
+    run    train to completion (clean reference, and the resume leg of
+           the grace test); prints FINAL + PSHA (sha256 of the params
+           bytes — the bitwise-identity witness).
+    kill   process 1 SIGKILLs itself at step <arg>; the survivor's
+           heartbeat watchdog must convert the ensuing silent hang into
+           a typed PeerLostError and hard-exit with code 17.
+    grace  slow the steps down (so the parent can SIGTERM mid-run);
+           on SIGTERM every process must agree on a stop step, write one
+           coordinated grace checkpoint, and exit 0.
+
+The health plane is armed via the DL4JTPU_HEARTBEAT_* env family set by
+the parent test (short timeouts). Deterministic: fixed seeds, fixed data
+order, fixed crash step.
+"""
+import os
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_force_host_platform_device_count=2"
+
+pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+ckpt_dir, mode, arg = sys.argv[4], sys.argv[5], int(sys.argv[6])
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from deeplearning4j_tpu import (DenseLayer, InputType, MultiLayerNetwork,  # noqa: E402
+                                NeuralNetConfiguration, Nesterovs,
+                                OutputLayer)
+from deeplearning4j_tpu.parallel import MultiHostRunner  # noqa: E402
+
+
+def build_net():
+    conf = (NeuralNetConfiguration.builder().seed(7)
+            .updater(Nesterovs(0.1, momentum=0.9))
+            .list()
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(8)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+class KillSelfAt:
+    """SIGKILL THIS process at a fixed optimizer step — the no-cleanup
+    death (no atexit, no socket close) the watchdog exists to detect."""
+
+    def __init__(self, step):
+        self.step = step
+
+    def iteration_done(self, model, iteration):
+        if iteration >= self.step:
+            print(f"KILLED {pid} at {iteration}", flush=True)
+            import signal as _signal
+            os.kill(os.getpid(), _signal.SIGKILL)
+
+
+class SlowStep:
+    """Pace the loop so the parent can SIGTERM between step boundaries."""
+
+    def iteration_done(self, model, iteration):
+        print(f"STEP {pid} {iteration}", flush=True)
+        time.sleep(0.25)
+
+
+runner = MultiHostRunner(coordinator_address=f"127.0.0.1:{port}",
+                         num_processes=nproc, process_id=pid).initialize()
+
+net = build_net()
+if mode == "kill" and pid == 1:
+    net.listeners.append(KillSelfAt(arg))
+if mode == "grace":
+    net.listeners.append(SlowStep())
+
+rng = np.random.default_rng(0)
+x = rng.standard_normal((96, 8)).astype(np.float32)
+y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, size=96)]
+# interleaved partitions (same contract as elastic_worker.py)
+xs = x.reshape(6, 16, 8)[:, pid * 8:(pid + 1) * 8].reshape(48, 8)
+ys = y.reshape(6, 16, 3)[:, pid * 8:(pid + 1) * 8].reshape(48, 3)
+
+from deeplearning4j_tpu.parallel.multihost import StepCheckpointManager  # noqa: E402
+
+latest = StepCheckpointManager(ckpt_dir).latest()
+print(f"RESUME_FROM {pid} {latest[0] if latest else -1}", flush=True)
+print(f"START {pid}", flush=True)
+
+try:
+    # 2 epochs x 6 batches = 12 optimizer steps, checkpoint every 4
+    runner.fit(net, xs, ys, epochs=2, batch_size=8,
+               checkpoint_dir=ckpt_dir, checkpoint_every=4)
+except SystemExit as e:
+    # the preemption-grace path: checkpoint written, clean exit
+    print(f"GRACE_EXIT {pid} step={runner.last_grace_step} code={e.code}",
+          flush=True)
+    raise
+
+runner.materialize_local(net)
+import hashlib  # noqa: E402
+
+digest = hashlib.sha256(
+    np.ascontiguousarray(np.asarray(net.params())).tobytes()).hexdigest()
+print(f"FINAL {pid} {float(np.abs(net.params()).sum()):.6f} "
+      f"iter={net.iteration}", flush=True)
+print(f"PSHA {pid} {digest}", flush=True)
+runner.stop_health()
+runner.barrier("done")
+print(f"DONE {pid}", flush=True)

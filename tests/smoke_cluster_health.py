@@ -1,0 +1,157 @@
+"""Cluster-health smoke for runtests.sh (docs/robustness.md
+§cluster-health) — the PR-8 chaos-smoke pattern: a hard signal.alarm
+bounds the whole script so a watchdog regression can never wedge the CI
+gate itself.
+
+Three legs, all gloo-free (the 2-process chaos rows are slow-marked
+pytest tests):
+
+  1. fake-clock watchdog transitions: dead peer -> PeerLostError,
+     frozen-but-beating peer -> ClusterDesyncError
+  2. timed_collective converts a wedged collective into a typed
+     BarrierTimeoutError
+  3. the REAL preemption path: a child process is SIGTERM'd mid-fit,
+     must write a grace checkpoint and exit 0, and the restarted run
+     must reach bitwise-identical final parameters
+"""
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+
+signal.alarm(300)  # the gate must never wedge, whatever breaks below
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from deeplearning4j_tpu.parallel import cluster_health as ch  # noqa: E402
+
+# ---- leg 1: watchdog state machine on a fake clock ------------------------
+clock_t = [0.0]
+clock = lambda: clock_t[0]  # noqa: E731
+transport = ch.InProcessBeatTransport(clock)
+cfg = ch.HealthConfig(interval_s=1, timeout_s=5, stall_timeout_s=10)
+fails = []
+m0 = ch.ClusterHealthMonitor(0, 2, transport, config=cfg, clock=clock,
+                             on_failure=fails.append)
+m1 = ch.ClusterHealthMonitor(1, 2, transport, config=cfg, clock=clock,
+                             on_failure=fails.append)
+m0._started_at = m1._started_at = clock()
+assert m0.poll_once() is None and m1.poll_once() is None
+clock_t[0] = 6.0  # peer 1 goes silent past timeout_s
+err = m0.poll_once()
+assert isinstance(err, ch.PeerLostError) and err.peers == [1], err
+assert fails == [err]
+print(f"[smoke_cluster_health] peer-lost: {type(err).__name__} "
+      f"peers={err.peers}")
+
+# frozen-but-beating peer: fresh transport, monitor 1 beats but never steps
+transport2 = ch.InProcessBeatTransport(clock)
+fails2 = []
+a = ch.ClusterHealthMonitor(0, 2, transport2, config=cfg, clock=clock,
+                            on_failure=fails2.append)
+b = ch.ClusterHealthMonitor(1, 2, transport2, config=cfg, clock=clock,
+                            on_failure=fails2.append)
+a._started_at = b._started_at = clock()
+step = 0
+derr = None
+for _ in range(13):
+    clock_t[0] += 1.0
+    step += 1
+    a.notify_step(step)  # a advances; b beats but stays frozen
+    derr = a.poll_once()
+    assert b.poll_once() is None
+    if derr is not None:
+        break
+assert isinstance(derr, ch.ClusterDesyncError) and derr.peers == [1], derr
+print(f"[smoke_cluster_health] desync: {type(derr).__name__} "
+      f"peers={derr.peers}")
+
+# ---- leg 2: timed collective fails typed instead of hanging ---------------
+release = threading.Event()
+try:
+    ch.timed_collective(release.wait, name="smoke-barrier", timeout_s=0.1)
+    raise AssertionError("wedged collective did not time out")
+except ch.BarrierTimeoutError as e:
+    print(f"[smoke_cluster_health] timed barrier: {e}")
+finally:
+    release.set()
+
+# ---- leg 3: real SIGTERM -> grace checkpoint -> bitwise resume ------------
+CHILD = r'''
+import os, signal, sys
+sys.path.insert(0, sys.argv[4])
+import jax
+jax.config.update("jax_platforms", "cpu")
+import hashlib
+import numpy as np
+from deeplearning4j_tpu import (DenseLayer, InputType, MultiLayerNetwork,
+                                NeuralNetConfiguration, Nesterovs,
+                                OutputLayer)
+from deeplearning4j_tpu.parallel import MultiHostRunner
+
+ckpt_dir, term_at = sys.argv[1], int(sys.argv[2])
+conf = (NeuralNetConfiguration.builder().seed(7)
+        .updater(Nesterovs(0.1, momentum=0.9)).list()
+        .layer(DenseLayer(n_out=16, activation="tanh"))
+        .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.feed_forward(8)).build())
+net = MultiLayerNetwork(conf).init()
+
+class TermAt:
+    def iteration_done(self, model, iteration):
+        if term_at >= 0 and iteration == term_at:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+net.listeners.append(TermAt())
+rng = np.random.default_rng(0)
+x = rng.standard_normal((48, 8)).astype(np.float32)
+y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, size=48)]
+runner = MultiHostRunner().initialize()
+try:
+    runner.fit(net, x, y, epochs=2, batch_size=8,
+               checkpoint_dir=ckpt_dir, checkpoint_every=100)
+except SystemExit as e:
+    print(f"GRACE step={runner.last_grace_step}", flush=True)
+    raise
+sha = hashlib.sha256(
+    np.ascontiguousarray(np.asarray(net.params())).tobytes()).hexdigest()
+print(f"FINAL iter={net.iteration} sha={sha}", flush=True)
+'''
+
+
+def run_child(ckpt_dir, term_at):
+    return subprocess.run(
+        [sys.executable, "-c", CHILD, ckpt_dir, str(term_at), "x",
+         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))],
+        capture_output=True, text=True, timeout=240)
+
+
+with tempfile.TemporaryDirectory() as tmp:
+    clean = run_child(os.path.join(tmp, "clean"), -1)
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    ref_sha = clean.stdout.split("sha=")[1].strip()
+
+    grace_dir = os.path.join(tmp, "grace")
+    graced = run_child(grace_dir, 3)
+    assert graced.returncode == 0, \
+        f"grace exit must be 0, got {graced.returncode}:\n" \
+        f"{graced.stdout}{graced.stderr}"
+    assert "GRACE step=3" in graced.stdout, graced.stdout
+    assert any(f.startswith("checkpoint_step")
+               for f in os.listdir(grace_dir)), os.listdir(grace_dir)
+
+    resumed = run_child(grace_dir, -1)
+    assert resumed.returncode == 0, resumed.stdout + resumed.stderr
+    res_sha = resumed.stdout.split("sha=")[1].strip()
+    assert res_sha == ref_sha, \
+        f"resume after grace not bitwise-identical:\n{ref_sha}\n{res_sha}"
+    print(f"[smoke_cluster_health] grace: SIGTERM at step 3 -> exit 0, "
+          f"checkpoint written, resume bitwise-identical (sha {ref_sha[:12]})")
+
+print("[smoke_cluster_health] OK")

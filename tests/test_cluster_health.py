@@ -1,0 +1,316 @@
+"""Cluster health plane unit tests — tier-1 by design: everything runs
+in-process on a fake clock with the sockets-free InProcessBeatTransport
+(the gloo chaos rows live in test_cluster_health_gloo.py, slow-marked).
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.parallel import cluster_health as ch
+from deeplearning4j_tpu.utils import faults
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+CFG = dict(interval_s=1.0, timeout_s=5.0, stall_timeout_s=10.0,
+           barrier_timeout_s=30.0)
+
+
+def make_pair(clock, **overrides):
+    """Two monitors sharing one in-process beat table, failures collected
+    instead of hard-exiting."""
+    cfg = ch.HealthConfig(**{**CFG, **overrides})
+    transport = ch.InProcessBeatTransport(clock)
+    fails = []
+    mons = [ch.ClusterHealthMonitor(p, 2, transport, config=cfg,
+                                    clock=clock, on_failure=fails.append)
+            for p in range(2)]
+    for m in mons:
+        m._started_at = clock()  # as start() would, without the thread
+    return mons, fails
+
+
+class TestWatchdogStateMachine:
+    def test_healthy_cluster_stays_healthy(self):
+        clock = FakeClock()
+        (m0, m1), fails = make_pair(clock)
+        for _ in range(20):
+            clock.advance(1.0)
+            assert m0.poll_once() is None
+            assert m1.poll_once() is None
+        assert not fails
+
+    def test_dead_peer_raises_peer_lost_with_id(self):
+        clock = FakeClock()
+        (m0, m1), fails = make_pair(clock)
+        m0.poll_once(), m1.poll_once()
+        # peer 1 stops beating; its beat age crosses timeout_s
+        clock.advance(5.5)
+        err = m0.poll_once()
+        assert isinstance(err, ch.PeerLostError)
+        assert err.peers == [1]
+        assert fails == [err]
+        # the failure is latched: the caller's thread sees it via check()
+        with pytest.raises(ch.PeerLostError):
+            m0.check()
+        # and further polls are no-ops returning the recorded failure
+        assert m0.poll_once() is err
+
+    def test_startup_grace_for_never_beaten_peer(self):
+        clock = FakeClock()
+        (m0, _), fails = make_pair(clock)
+        # peer 1 never beats at all; within the assembly window that is
+        # NOT a failure (its process may still be initializing jax)
+        clock.advance(4.0)
+        assert m0.poll_once() is None
+        # past timeout_s from start, a silent peer is lost ("never")
+        clock.advance(2.0)
+        err = m0.poll_once()
+        assert isinstance(err, ch.PeerLostError) and err.peers == [1]
+        assert "never" in str(err)
+
+    def test_beating_but_frozen_peer_raises_desync(self):
+        clock = FakeClock()
+        (m0, m1), fails = make_pair(clock)
+        step = 0
+        # both advance together for a while
+        for _ in range(3):
+            clock.advance(1.0)
+            step += 1
+            m0.notify_step(step)
+            m1.notify_step(step)
+            assert m0.poll_once() is None and m1.poll_once() is None
+        # peer 1 keeps beating but its step freezes while 0 advances
+        # (stall_timeout_s is strict: the freeze must EXCEED 10s)
+        for _ in range(12):
+            clock.advance(1.0)
+            step += 1
+            m0.notify_step(step)
+            err0 = m0.poll_once()
+            assert m1.poll_once() is None  # the frozen peer blames nobody
+            if err0 is not None:
+                break
+        assert isinstance(err0, ch.ClusterDesyncError)
+        assert err0.peers == [1]
+        assert fails == [err0]
+
+    def test_frozen_everywhere_is_not_a_desync(self):
+        # a cluster-wide stall (slow storage, long compile) must not be
+        # blamed on a peer: lag stays 0, only the timed barrier may fire
+        clock = FakeClock()
+        (m0, m1), fails = make_pair(clock)
+        for _ in range(30):
+            clock.advance(1.0)
+            assert m0.poll_once() is None and m1.poll_once() is None
+        assert not fails
+
+    def test_chief_channel_unreachable_marks_chief_lost(self):
+        clock = FakeClock()
+
+        class DeadChannel:
+            chief = False  # non-chief view: the chief hosts the server
+
+            def publish(self, beat):
+                raise OSError("connection refused")
+
+            def table(self):
+                raise OSError("connection refused")
+
+            def close(self):
+                pass
+
+        fails = []
+        m = ch.ClusterHealthMonitor(
+            1, 2, DeadChannel(), config=ch.HealthConfig(**CFG),
+            clock=clock, on_failure=fails.append)
+        m._started_at = clock()
+        assert m.poll_once() is None  # first failure only starts the timer
+        clock.advance(5.5)
+        err = m.poll_once()
+        assert isinstance(err, ch.PeerLostError) and err.peers == [0]
+
+
+class TestGraceAndSteps:
+    def test_grace_flag_rides_the_beats(self):
+        clock = FakeClock()
+        (m0, m1), _ = make_pair(clock)
+        m1.request_grace()
+        assert m1.grace_requested()
+        assert not m0.grace_requested()
+        m1.poll_once()      # publish the grace bit
+        m0.poll_once()      # read it from the table
+        assert m0.grace_requested()
+
+    def test_notify_step_is_monotonic(self):
+        clock = FakeClock()
+        (m0, _), _ = make_pair(clock)
+        m0.notify_step(5)
+        m0.notify_step(3)   # stale report must not rewind progress
+        with m0._lock:
+            assert m0._step == 5
+
+    def test_step_stall_fault_point_freezes_reports(self):
+        clock = FakeClock()
+        (m0, _), _ = make_pair(clock)
+        m0.notify_step(1)
+        with faults.injected("step.stall", "fail:*"):
+            m0.notify_step(2)
+        with m0._lock:
+            assert m0._step == 1  # the report was swallowed
+
+    def test_heartbeat_send_fault_point_suppresses_beats(self):
+        clock = FakeClock()
+        (m0, m1), fails = make_pair(clock)
+        m0.poll_once(), m1.poll_once()
+        with faults.injected("heartbeat.send", "fail:*"):
+            # peer 1's beats all fail; after timeout_s peer 0 sees it die
+            for _ in range(6):
+                clock.advance(1.0)
+                m1.poll_once()
+            err = m0.poll_once()
+            fired = faults.fired_count("heartbeat.send")
+        assert isinstance(err, ch.PeerLostError) and err.peers == [1]
+        assert fired >= 6  # every one of peer 1's sends was suppressed
+
+
+class TestConfigAndMetrics:
+    def test_from_env_reads_the_heartbeat_family(self, monkeypatch):
+        monkeypatch.setenv("DL4JTPU_HEARTBEAT_INTERVAL_S", "0.25")
+        monkeypatch.setenv("DL4JTPU_HEARTBEAT_TIMEOUT_S", "3")
+        monkeypatch.setenv("DL4JTPU_HEARTBEAT_STALL_S", "7")
+        monkeypatch.setenv("DL4JTPU_HEARTBEAT_BARRIER_TIMEOUT_S", "11")
+        monkeypatch.setenv("DL4JTPU_HEARTBEAT_GRACE_EVERY", "2")
+        monkeypatch.setenv("DL4JTPU_HEARTBEAT_PORT", "12345")
+        cfg = ch.HealthConfig.from_env()
+        assert (cfg.interval_s, cfg.timeout_s, cfg.stall_timeout_s,
+                cfg.barrier_timeout_s, cfg.grace_every, cfg.port) == \
+            (0.25, 3.0, 7.0, 11.0, 2, 12345)
+
+    def test_health_enabled_from_env(self, monkeypatch):
+        monkeypatch.delenv("DL4JTPU_HEARTBEAT", raising=False)
+        assert not ch.health_enabled_from_env()
+        monkeypatch.setenv("DL4JTPU_HEARTBEAT", "0")
+        assert not ch.health_enabled_from_env()
+        monkeypatch.setenv("DL4JTPU_HEARTBEAT", "1")
+        assert ch.health_enabled_from_env()
+
+    def test_register_metrics_registers_every_family(self):
+        reg = ch.register_metrics()
+        text = reg.prometheus_text()
+        for name in ("cluster_peer_beat_age_seconds", "cluster_peer_step_lag",
+                     "cluster_heartbeats_sent_total", "cluster_desync_total",
+                     "cluster_grace_checkpoints_total",
+                     "cluster_heartbeat_failures_total"):
+            assert name in text, name
+
+    def test_monitor_thread_start_stop(self):
+        # one real (non-fake-clock) lifecycle: daemon thread spins up,
+        # beats at least once, and stop() joins it
+        transport = ch.InProcessBeatTransport()
+        fails = []
+        m = ch.ClusterHealthMonitor(
+            0, 1, transport,
+            config=ch.HealthConfig(interval_s=0.01, timeout_s=5,
+                                   stall_timeout_s=5),
+            on_failure=fails.append).start()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if transport.table()["beats"]:
+                break
+            time.sleep(0.01)
+        m.stop()
+        assert "0" in transport.table()["beats"]
+        assert not fails
+
+
+class TestTimedCollective:
+    def test_fast_collective_passes_value_through(self):
+        assert ch.timed_collective(lambda: 42, name="x", timeout_s=5) == 42
+
+    def test_no_timeout_is_direct_call(self):
+        assert ch.timed_collective(lambda: 7, name="x", timeout_s=None) == 7
+
+    def test_worker_exception_propagates(self):
+        def boom():
+            raise ValueError("inner")
+        with pytest.raises(ValueError, match="inner"):
+            ch.timed_collective(boom, name="x", timeout_s=5)
+
+    def test_hanging_collective_raises_typed_timeout(self):
+        release = threading.Event()
+        try:
+            with pytest.raises(ch.BarrierTimeoutError, match="wedge-me"):
+                ch.timed_collective(release.wait, name="wedge-me",
+                                    timeout_s=0.05)
+        finally:
+            release.set()  # unblock the abandoned worker thread
+
+    def test_monitor_diagnosis_preferred_over_generic_timeout(self):
+        clock = FakeClock()
+        (m0, _), _ = make_pair(clock)
+        m0.poll_once()
+        clock.advance(6.0)
+        m0.poll_once()  # records PeerLostError
+        release = threading.Event()
+        try:
+            with pytest.raises(ch.PeerLostError):
+                ch.timed_collective(release.wait, name="b", timeout_s=0.05,
+                                    monitor=m0)
+        finally:
+            release.set()
+
+
+class TestCheckpointManagerSplit:
+    def test_deprecated_alias_identity(self):
+        from deeplearning4j_tpu.parallel import multihost
+        assert multihost.CheckpointManager is multihost.StepCheckpointManager
+        import deeplearning4j_tpu.parallel as P
+        assert P.CheckpointManager is P.StepCheckpointManager
+
+    def test_latest_valid_skips_torn_newest(self, tmp_path):
+        from deeplearning4j_tpu import (DenseLayer, InputType,
+                                        MultiLayerNetwork,
+                                        NeuralNetConfiguration, OutputLayer,
+                                        Sgd)
+        from deeplearning4j_tpu.optimize import metrics as metrics_mod
+        from deeplearning4j_tpu.parallel.multihost import StepCheckpointManager
+        conf = (NeuralNetConfiguration.builder().seed(1).updater(Sgd(0.1))
+                .list()
+                .layer(DenseLayer(n_out=4, activation="tanh"))
+                .layer(OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(3)).build())
+        net = MultiLayerNetwork(conf).init()
+        mgr = StepCheckpointManager(str(tmp_path))
+        mgr.save(net, 2)
+        good = net.params().copy()
+        mgr.save(net, 4)
+        # tear the newest file (a kill during a non-atomic copy INTO the
+        # dir); resume must fall back to step 2 instead of crashing
+        newest = tmp_path / "checkpoint_step4.zip"
+        newest.write_bytes(b"torn checkpoint, not a zip")
+        assert mgr.latest()[0] == 4
+        assert mgr.latest_valid()[0] == 2
+        restored = mgr.restore_into(net)
+        assert restored == 2
+        np.testing.assert_array_equal(net.params(), good)
+        text = metrics_mod.registry().prometheus_text()
+        assert "checkpoint_corrupt_total" in text
+
+    def test_latest_valid_none_when_all_corrupt(self, tmp_path):
+        from deeplearning4j_tpu.parallel.multihost import StepCheckpointManager
+        mgr = StepCheckpointManager(str(tmp_path))
+        (tmp_path / "checkpoint_step1.zip").write_bytes(b"garbage")
+        assert mgr.latest_valid() is None
+        assert mgr.restore_into(object()) is None

@@ -1,0 +1,142 @@
+"""Cluster-health chaos rows: real 2-process gloo jobs under SIGKILL and
+SIGTERM (the acceptance bar of the health plane). Slow-marked — each row
+spawns full jax.distributed subprocesses; the cheap in-process unit
+coverage lives in test_cluster_health.py."""
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _health_env():
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env.update({
+        "DL4JTPU_HEARTBEAT": "1",
+        "DL4JTPU_HEARTBEAT_INTERVAL_S": "0.2",
+        "DL4JTPU_HEARTBEAT_TIMEOUT_S": "2",
+        "DL4JTPU_HEARTBEAT_STALL_S": "8",
+        "DL4JTPU_HEARTBEAT_BARRIER_TIMEOUT_S": "30",
+        "DL4JTPU_HEARTBEAT_PORT": str(_free_port()),
+    })
+    return env
+
+
+def _spawn(port, ckpt_dir, mode, arg):
+    env = _health_env()
+    return [subprocess.Popen(
+        [sys.executable, os.path.join(HERE, "health_worker.py"),
+         str(p), "2", str(port), ckpt_dir, mode, str(arg)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for p in range(2)]
+
+
+def _run_to_completion(port, ckpt_dir):
+    procs = _spawn(port, ckpt_dir, "run", -1)
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=600)
+        outs.append(out)
+        assert p.returncode == 0, f"worker failed:\n{out}"
+    return outs
+
+
+def _sha(outs):
+    vals = {}
+    for out in outs:
+        for m in re.finditer(r"^PSHA (\d+) ([0-9a-f]{64})$", out, re.M):
+            vals[int(m.group(1))] = m.group(2)
+    assert set(vals) == {0, 1}, f"missing PSHA lines:\n{outs}"
+    return vals
+
+
+class TestSigkillToTypedFailure:
+    def test_survivor_exits_typed_within_deadline(self, tmp_path):
+        """SIGKILL one worker mid-step: without the watchdog the
+        survivor hangs forever at the next collective (proven by
+        test_multihost's expect_fail row, which must kill it). With the
+        plane armed, the survivor must exit EXIT_CODE=17 with a typed
+        PeerLostError diagnosis within the watchdog deadline."""
+        procs = _spawn(_free_port(), str(tmp_path / "ck"), "kill", 5)
+        out1, _ = procs[1].communicate(timeout=600)
+        assert procs[1].returncode == -signal.SIGKILL, out1
+        assert "KILLED 1 at 5" in out1
+        t0 = time.monotonic()
+        # deadline: TIMEOUT_S (2s) + polling slack, NOT the 600s hang
+        # budget — generous wall margin for the 1-core CI box, but the
+        # communicate() below would hang forever on a wedged survivor
+        # without the watchdog
+        try:
+            out0, _ = procs[0].communicate(timeout=120)
+        except subprocess.TimeoutExpired:
+            procs[0].kill()
+            raise AssertionError(
+                "survivor hung >120s after peer SIGKILL — watchdog "
+                "did not convert the hang into a typed failure")
+        elapsed = time.monotonic() - t0
+        assert procs[0].returncode == 17, \
+            f"expected watchdog exit code 17, got " \
+            f"{procs[0].returncode}:\n{out0}"
+        assert "PeerLostError" in out0, out0
+        assert re.search(r"peers=\[1\]", out0), out0
+        assert elapsed < 120
+
+
+class TestSigtermToGraceCheckpoint:
+    def test_grace_checkpoint_and_bitwise_identical_resume(self, tmp_path):
+        # 1) clean uninterrupted reference
+        ref = _sha(_run_to_completion(_free_port(), str(tmp_path / "clean")))
+        assert ref[0] == ref[1]
+
+        # 2) SIGTERM the job mid-run: every process must write/join one
+        # coordinated grace checkpoint and exit 0
+        grace_dir = str(tmp_path / "grace")
+        procs = _spawn(_free_port(), grace_dir, "grace", -1)
+        # wait until proc 0 is stepping (SIGTERM handler installed and
+        # the loop is between step boundaries), then preempt the job
+        deadline = time.monotonic() + 300
+        for line in procs[0].stdout:
+            if line.startswith("STEP 0 "):
+                break
+            assert time.monotonic() < deadline, "worker never stepped"
+        time.sleep(0.1)
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        outs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=120)
+            outs.append(out)
+            assert p.returncode == 0, \
+                f"grace exit must be clean (got {p.returncode}):\n{out}"
+        joined = "\n".join(outs)
+        assert re.search(r"^GRACE_EXIT 1 step=(\d+) code=0$", joined, re.M), \
+            joined
+        saved = sorted(os.listdir(grace_dir))
+        assert any(s.startswith("checkpoint_step") for s in saved), saved
+
+        # 3) restart on the same dir: auto-resume through replay-skip
+        # must reach the SAME final parameters, bit for bit
+        outs = _run_to_completion(_free_port(), grace_dir)
+        assert any(re.search(r"^RESUME_FROM \d+ (\d+)$", o, re.M)
+                   for o in outs), outs
+        resumed = _sha(outs)
+        assert resumed[0] == ref[0], "resume after grace checkpoint is " \
+            "not bitwise-identical to the uninterrupted run"
+        assert resumed[1] == ref[0]

@@ -48,6 +48,7 @@ class TestZooInstantiation:
         _check_mln(VGG19(num_labels=4, input_shape=(32, 32, 3)),
                    32, 32, 3, 4, batch=1)
 
+    @pytest.mark.slow  # ~36s on the 1-core rig: tier-1 budget (ROADMAP)
     def test_resnet50(self):
         model = ResNet50(num_labels=6, input_shape=(64, 64, 3))
         g = model.init()
@@ -61,6 +62,7 @@ class TestZooInstantiation:
         g.fit_batch(MultiDataSet([x], [y]))
         assert np.isfinite(float(g.score_value))
 
+    @pytest.mark.slow  # ~24s on the 1-core rig
     def test_googlenet(self):
         model = GoogLeNet(num_labels=6, input_shape=(64, 64, 3))
         g = model.init()
@@ -91,6 +93,7 @@ class TestZooCompletion:
     (InceptionResNetV1.java, FaceNetNN4Small2.java) — face-recognition
     graphs with bottleneck embedding, L2-normalize vertex, center loss."""
 
+    @pytest.mark.slow  # ~66s on the 1-core rig: the single heaviest test
     def test_inception_resnet_v1(self):
         from deeplearning4j_tpu.models import InceptionResNetV1
         model = InceptionResNetV1(num_labels=7, input_shape=(64, 64, 3))
@@ -104,6 +107,7 @@ class TestZooCompletion:
               use_async=False)
         assert np.isfinite(float(g.score_value))
 
+    @pytest.mark.slow  # ~25s on the 1-core rig
     def test_facenet_nn4_small2(self):
         from deeplearning4j_tpu.models import FaceNetNN4Small2
         model = FaceNetNN4Small2(num_labels=9, input_shape=(96, 96, 3))
