@@ -269,6 +269,59 @@ def bench_googlenet(batch=512, steps=10, repeats=3):
     return (batch * steps) / dt
 
 
+def bench_googlenet_pool_ab(batch=512, steps=10, repeats=3):
+    """Standing A/B for the round-6 GoogLeNet attacks (ISSUE 10): full
+    train-step img/s of the 2x2 grid {unfused, fused inception 1x1
+    branches} x {sns, mask max-pool backward}. Fusion rides
+    GoogLeNet(fuse_siblings=True) (nn/graph/fusion.py — exact concat
+    rewrite, bitwise forward); the pool axis rides pooling_impl=
+    (ops/pooling.py — S&S vs argmax-equality-mask backward, round-5
+    profile put 9.5 ms/step at 2.1x byte bound in S&S). The dispatch
+    defaults in select_pooling_impl / the zoo knobs ship whatever wins
+    here; docs/perf_googlenet.md round 6 records the sweep. Each arm is
+    a fresh net + fresh jit so the four compiles never share traces."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.models import GoogLeNet
+    from deeplearning4j_tpu.data.dataset import MultiDataSet
+
+    rng = np.random.default_rng(0)
+    x = jax.device_put(jnp.asarray(
+        rng.standard_normal((batch, 224, 224, 3)), jnp.bfloat16))
+    y = jax.device_put(
+        np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, batch)])
+    mds = MultiDataSet([x], [y])
+
+    arms = [(f"{'fused' if fuse else 'unfused'}_{impl}", fuse, impl)
+            for fuse in (False, True) for impl in ("sns", "mask")]
+    extras = {"batch": batch}
+    best = None
+    for name, fuse, impl in arms:
+        g = GoogLeNet(num_labels=1000, fuse_siblings=fuse,
+                      pooling_impl=impl).init(dtype=jnp.bfloat16)
+        g.fit_batch_repeated(mds, steps)
+        float(g.score_value)  # fence (compile + warm)
+        times = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            g.fit_batch_repeated(mds, steps)
+            float(g.score_value)
+            times.append(time.perf_counter() - t0)
+        dt = sorted(times)[len(times) // 2]
+        ips = (batch * steps) / dt
+        # 3 decimals: CPU-host runs of this row sit at O(0.1) img/s and
+        # the winner must still be resolvable from the extras.
+        extras[f"img_s_{name}"] = round(ips, 3)
+        extras[f"step_ms_{name}"] = round(dt / steps * 1e3, 1)
+        extras[f"est_mfu_{name}"] = _mfu(ips,
+                                         GOOGLENET_TRAIN_FLOPS_PER_IMAGE)
+        if best is None or ips > best[1]:
+            best = (name, ips)
+        del g  # free the arm's buffers before the next compile
+    extras["winner"] = best[0]
+    return best[1], extras
+
+
 def bench_attention(batch=64, seq_len=512, width=256, heads=8, steps=10,
                     repeats=3):
     """Self-attention char-model training tokens/sec (BEYOND-parity
@@ -830,9 +883,15 @@ def run_once(workload: str, arg):
         return ("resnet50_imagenet_bf16_images_per_sec_per_chip", ips,
                 "images/sec",
                 {"est_mfu": _mfu(ips, RESNET50_TRAIN_FLOPS_PER_IMAGE)})
+    if workload == "googlenet_pool_ab":
+        batch = int(arg) if arg else 512
+        ips, ext = bench_googlenet_pool_ab(batch=batch)
+        return (f"googlenet_pool_ab_b{batch}_images_per_sec", ips,
+                "images/sec", ext)
     raise SystemExit(
         f"Unknown workload {workload!r}; use resnet50 [batch] | vgg16 | "
-        "googlenet | attention | attention_longctx [seq] | "
+        "googlenet | googlenet_pool_ab [batch] | attention | "
+        "attention_longctx [seq] | "
         "attention_ab [seq] | alexnet | "
         "alexnet_pallaslrn | lenet | lenet_tiny | lstm | w2v [scale] | "
         "etl | lenet_hostfed | serving")
@@ -870,6 +929,13 @@ def main():
         # MULTICHIP snapshots always carry them, beats or no beats.
         from deeplearning4j_tpu.parallel import cluster_health
         cluster_health.register_metrics()
+        # Round-6 dispatch families (pooling_impl_selected_total,
+        # sibling_conv_fusion_total): every label at 0 before the first
+        # trace, so snapshots distinguish "never selected" from absent.
+        from deeplearning4j_tpu.nn.graph import fusion as graph_fusion
+        from deeplearning4j_tpu.ops import pooling as pooling_ops
+        pooling_ops.register_metrics()
+        graph_fusion.register_metrics()
         with CompilationTracker() as trk:
             metric, ips, unit, extra = run_once(workload, arg)
         # XLA compilations the measurement triggered: warm-up should own

@@ -459,7 +459,19 @@ class ResNet50(ZooModel):
 @dataclass
 class GoogLeNet(ZooModel):
     """Reference zoo/model/GoogLeNet.java:83-180 (Szegedy et al. inception
-    v1; Nesterovs(1e-2, 0.9), l2 2e-4 relu)."""
+    v1; Nesterovs(1e-2, 0.9), l2 2e-4 relu).
+
+    `fuse_siblings=True` runs the sibling-conv fusion pass
+    (nn/graph/fusion.py) over the built config: each block's
+    cnn1/cnn2/cnn3 1×1 triple becomes one channel-concatenated conv plus
+    SubsetVertex slices — same math, one MXU contraction and one
+    activation read instead of three. `pooling_impl` threads the
+    pooling-backward knob (ops/pooling.py) through every
+    SubsamplingLayer. Both default to the measured round-6 winners
+    (docs/perf_googlenet.md)."""
+
+    fuse_siblings: bool = False
+    pooling_impl: str = "auto"
 
     def _inception(self, g, name, cfg, inp):
         # cfg = [[c1x1], [c3r, c3], [c5r, c5], [pool_proj]]
@@ -471,7 +483,8 @@ class GoogLeNet(ZooModel):
             kernel_size=(1, 1), n_out=cfg[2][0], bias_init=0.2), inp)
         g.add_layer(f"{name}-max1", SubsamplingLayer(
             kernel_size=(3, 3), stride=(1, 1), pooling_type=PoolingType.MAX,
-            convolution_mode=ConvolutionMode.SAME), inp)
+            convolution_mode=ConvolutionMode.SAME,
+            pooling_impl=self.pooling_impl), inp)
         g.add_layer(f"{name}-cnn4", ConvolutionLayer(
             kernel_size=(3, 3), padding=(1, 1), n_out=cfg[1][1],
             bias_init=0.2), f"{name}-cnn2")
@@ -502,7 +515,8 @@ class GoogLeNet(ZooModel):
             bias_init=0.2), "input")
         g.add_layer("max1", SubsamplingLayer(
             kernel_size=(3, 3), stride=(2, 2), padding=(1, 1),
-            pooling_type=PoolingType.MAX), "cnn1")
+            pooling_type=PoolingType.MAX,
+            pooling_impl=self.pooling_impl), "cnn1")
         g.add_layer("lrn1", LocalResponseNormalization(), "max1")
         g.add_layer("cnn2", ConvolutionLayer(
             kernel_size=(1, 1), n_out=64, bias_init=0.2), "lrn1")
@@ -512,14 +526,16 @@ class GoogLeNet(ZooModel):
         g.add_layer("lrn2", LocalResponseNormalization(), "cnn3")
         g.add_layer("max2", SubsamplingLayer(
             kernel_size=(3, 3), stride=(2, 2), padding=(1, 1),
-            pooling_type=PoolingType.MAX), "lrn2")
+            pooling_type=PoolingType.MAX,
+            pooling_impl=self.pooling_impl), "lrn2")
 
         x = self._inception(g, "3a", [[64], [96, 128], [16, 32], [32]],
                             "max2")
         x = self._inception(g, "3b", [[128], [128, 192], [32, 96], [64]], x)
         g.add_layer("max3", SubsamplingLayer(
             kernel_size=(3, 3), stride=(2, 2), padding=(1, 1),
-            pooling_type=PoolingType.MAX), x)
+            pooling_type=PoolingType.MAX,
+            pooling_impl=self.pooling_impl), x)
         x = self._inception(g, "4a", [[192], [96, 208], [16, 48], [64]],
                             "max3")
         x = self._inception(g, "4b", [[160], [112, 224], [24, 64], [64]], x)
@@ -528,7 +544,8 @@ class GoogLeNet(ZooModel):
         x = self._inception(g, "4e", [[256], [160, 320], [32, 128], [128]], x)
         g.add_layer("max4", SubsamplingLayer(
             kernel_size=(3, 3), stride=(2, 2), padding=(1, 1),
-            pooling_type=PoolingType.MAX), x)
+            pooling_type=PoolingType.MAX,
+            pooling_impl=self.pooling_impl), x)
         x = self._inception(g, "5a", [[256], [160, 320], [32, 128], [128]],
                             "max4")
         x = self._inception(g, "5b", [[384], [192, 384], [48, 128], [128]], x)
@@ -539,7 +556,11 @@ class GoogLeNet(ZooModel):
             n_out=self.num_labels, activation="softmax", loss="mcxent"),
             "fc1")
         g.set_outputs("output")
-        return g.build()
+        conf = g.build()
+        if self.fuse_siblings:
+            from ..nn.graph.fusion import fuse_sibling_convs
+            conf, _ = fuse_sibling_convs(conf)
+        return conf
 
 
 @dataclass

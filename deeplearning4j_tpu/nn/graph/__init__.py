@@ -1,4 +1,6 @@
 """ComputationGraph: DAG networks (reference deeplearning4j-nn nn/graph)."""
+from .fusion import (FusionGroup, find_sibling_conv_groups, fuse_graph,
+                     fuse_params, fuse_sibling_convs, unfuse_params)
 from .graph import ComputationGraph
 from .vertices import (DuplicateToTimeSeriesVertex, ElementWiseVertex,
                        GraphVertex, L2NormalizeVertex, L2Vertex,

@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ...ops import pooling as pool_ops
 from ...utils import serde
 from ..conf.inputs import ConvolutionalType, FeedForwardType, InputType
 from .core import BIAS, WEIGHT, Layer, dropout
@@ -282,6 +283,11 @@ class SubsamplingLayer(Layer):
     convolution_mode: Optional[ConvolutionMode] = None  # None -> inherit/Truncate
     pnorm: int = 2
     eps: float = 1e-8
+    # Backward-pass implementation knob (ops/pooling.py): "auto" follows
+    # the measured dispatch rule; MAX accepts "sns"/"mask", AVG
+    # "window"/"conv". Selection is counted in
+    # pooling_impl_selected_total{impl=} at trace time.
+    pooling_impl: str = "auto"
 
     def input_kind(self):
         return "cnn"
@@ -311,23 +317,31 @@ class SubsamplingLayer(Layer):
             pads = ((0, 0), (ph, ph), (pw, pw), (0, 0))
         window = (1, kh, kw, 1)
         strides = (1, sh, sw, 1)
+        spatial_pads = (pads[1], pads[2])
         pt = self.pooling_type
         if pt == PoolingType.MAX:
-            # reduce_window + select-and-scatter VJP is the fastest
-            # formulation XLA offers here; both non-overlapping-window
-            # alternatives (reshape-max and strided-slice max) measured
-            # SLOWER end-to-end on VGG16 (178 -> 197 / 243 ms/step,
-            # docs/perf_vgg16.md "attempted, rejected").
-            out = lax.reduce_window(x, -jnp.inf, lax.max, window, strides, pads)
+            # Backward-emitter dispatch (ops/pooling.py): "sns" keeps
+            # XLA's reduce_window + select-and-scatter VJP — the fastest
+            # formulation for VGG16-sized pools (reshape-max and
+            # strided-slice max measured SLOWER, 178 -> 197 / 243
+            # ms/step, docs/perf_vgg16.md); "mask" swaps in the
+            # argmax-equality-mask backward (no S&S). "auto" follows the
+            # measured rule in docs/perf_googlenet.md round 6.
+            impl = pool_ops.select_pooling_impl(
+                "max", (kh, kw), (sh, sw), requested=self.pooling_impl)
+            out = pool_ops.max_pool(x, (kh, kw), (sh, sw), spatial_pads,
+                                    impl=impl)
         elif pt == PoolingType.SUM:
             out = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
         elif pt == PoolingType.AVG:
-            s = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
             # Divisor counts only in-bounds elements (matches reference
-            # average-pool edge behavior under padding).
-            ones = jnp.ones_like(x)
-            cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, pads)
-            out = s / cnt
+            # average-pool edge behavior under padding); "conv" trades
+            # the reduce_window pair for a depthwise conv whose backward
+            # is a transposed conv.
+            impl = pool_ops.select_pooling_impl(
+                "avg", (kh, kw), (sh, sw), requested=self.pooling_impl)
+            out = pool_ops.avg_pool(x, (kh, kw), (sh, sw), spatial_pads,
+                                    impl=impl)
         elif pt == PoolingType.PNORM:
             p = float(self.pnorm)
             s = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add, window, strides,
@@ -371,7 +385,8 @@ class Subsampling1DLayer(SubsamplingLayer):
         layer2d = SubsamplingLayer(
             kernel_size=(k, 1), stride=(s, 1), padding=(p, 0),
             pooling_type=self.pooling_type, convolution_mode=self._mode(),
-            pnorm=self.pnorm, eps=self.eps, dropout_rate=self.dropout_rate)
+            pnorm=self.pnorm, eps=self.eps, dropout_rate=self.dropout_rate,
+            pooling_impl=self.pooling_impl)
         out, _ = layer2d.forward(params, state, x4, train=train, rng=rng, mask=mask)
         return out[:, :, 0, :], state
 

@@ -21,6 +21,13 @@ JAX_PLATFORMS=cpu python tests/smoke_analysis.py
 # the suite like the jaxlint step.
 JAX_PLATFORMS=cpu python tests/smoke_attention.py
 
+# Pooling + fusion smoke (docs/perf_googlenet.md round 6): mask max-pool
+# backward vs select-and-scatter autodiff, depthwise-conv avg pool vs
+# reduce_window, the pooling_impl dispatch contract, and the sibling-
+# conv fusion pass bitwise-forward on an initialized graph. Seconds —
+# gates before the suite like the attention smoke.
+JAX_PLATFORMS=cpu python tests/smoke_pooling.py
+
 python -m pytest tests/ -q "$@"
 
 # Observability smoke (docs/observability.md): a real 2-epoch fit with

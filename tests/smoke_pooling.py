@@ -1,0 +1,118 @@
+"""Pooling + fusion smoke: CPU gate for the round-6 GoogLeNet attacks
+(ISSUE 10, docs/perf_googlenet.md round 6).
+
+Exercises the REAL code paths end to end: the argmax-equality-mask
+max-pool backward against XLA's select-and-scatter VJP, the depthwise-
+conv average pool against reduce_window, the dispatch selector (auto
+rule, probe, counter family), and the sibling-conv fusion pass applied
+to an initialized graph (bitwise forward across the rewrite).
+
+Run by runtests.sh as a separate step (no test_ prefix on purpose —
+this is the end-to-end gate, kept out of the pytest budget). Exits
+nonzero on any failed expectation.
+
+Usage: JAX_PLATFORMS=cpu python tests/smoke_pooling.py
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.ops import pooling
+    from deeplearning4j_tpu.optimize.metrics import registry
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((2, 9, 9, 3)), jnp.float32)
+    geo = dict(window=(3, 3), strides=(2, 2), pads=((1, 1), (1, 1)))
+
+    # 1) mask backward vs select-and-scatter autodiff
+    y_sns = pooling.max_pool(x, impl="sns", **geo)
+    y_mask = pooling.max_pool(x, impl="mask", **geo)
+    if not np.array_equal(np.asarray(y_sns), np.asarray(y_mask)):
+        print("smoke_pooling: mask forward not bitwise")
+        return 1
+    g_sns = jax.grad(lambda a: jnp.sum(
+        pooling.max_pool(a, impl="sns", **geo) ** 2))(x)
+    g_mask = jax.grad(lambda a: jnp.sum(
+        pooling.max_pool(a, impl="mask", **geo) ** 2))(x)
+    np.testing.assert_allclose(np.asarray(g_mask), np.asarray(g_sns),
+                               rtol=2e-6, atol=2e-6)
+    print("smoke_pooling: mask backward parity ok")
+
+    # 2) avg conv-vs-window, fwd + bwd
+    a_w = pooling.avg_pool(x, impl="window", **geo)
+    a_c = pooling.avg_pool(x, impl="conv", **geo)
+    np.testing.assert_allclose(np.asarray(a_c), np.asarray(a_w),
+                               rtol=2e-6, atol=2e-6)
+    ga_w = jax.grad(lambda a: jnp.sum(
+        pooling.avg_pool(a, impl="window", **geo)))(x)
+    ga_c = jax.grad(lambda a: jnp.sum(
+        pooling.avg_pool(a, impl="conv", **geo)))(x)
+    np.testing.assert_allclose(np.asarray(ga_c), np.asarray(ga_w),
+                               rtol=2e-6, atol=2e-6)
+    print("smoke_pooling: avg conv/window parity ok")
+
+    # 3) dispatch: auto rule, override, probe, counter family
+    pooling.register_metrics()
+    # measured per-backend rule: mask on CPU (this gate), sns on TPU
+    if pooling.select_pooling_impl("max", (3, 3), (2, 2)) != "mask":
+        print("smoke_pooling: auto rule drifted from the measured default")
+        return 1
+    if pooling.select_pooling_impl("max", (3, 3), (2, 2),
+                                   requested="mask") != "mask":
+        print("smoke_pooling: mask unavailable on this backend")
+        return 1
+    text = registry().prometheus_text()
+    if "pooling_impl_selected_total" not in text:
+        print("smoke_pooling: counter family missing from registry")
+        return 1
+    print("smoke_pooling: dispatch + counter family ok")
+
+    # 4) sibling-conv fusion on an initialized graph: bitwise forward
+    from deeplearning4j_tpu import (ComputationGraph, InputType,
+                                    NeuralNetConfiguration, OutputLayer, Sgd)
+    from deeplearning4j_tpu.nn.graph import fusion
+    from deeplearning4j_tpu.nn.graph.vertices import MergeVertex
+    from deeplearning4j_tpu.nn.layers.convolution import (ConvolutionLayer,
+                                                          GlobalPoolingLayer,
+                                                          PoolingType)
+
+    g = (NeuralNetConfiguration.builder().seed(3).activation("relu")
+         .updater(Sgd(0.1)).graph_builder().add_inputs("input"))
+    for i, n in enumerate((3, 4, 2)):
+        g.add_layer(f"b-cnn{i + 1}",
+                    ConvolutionLayer(n_out=n, kernel_size=(1, 1)), "input")
+    g.add_vertex("merge", MergeVertex(), "b-cnn1", "b-cnn2", "b-cnn3")
+    g.add_layer("pool", GlobalPoolingLayer(pooling_type=PoolingType.AVG),
+                "merge")
+    g.add_layer("output", OutputLayer(n_out=2, activation="softmax",
+                                      loss="mcxent"), "pool")
+    g.set_outputs("output")
+    g.set_input_types(InputType.convolutional(6, 6, 4))
+    net = ComputationGraph(g.build()).init()
+    fused = fusion.fuse_graph(net)
+    if "b-cnn1+b-cnn2+b-cnn3" not in fused.conf.nodes:
+        print("smoke_pooling: fusion pass found no group")
+        return 1
+    xg = jnp.asarray(rng.standard_normal((2, 6, 6, 4)), jnp.float32)
+    if not np.array_equal(np.asarray(net.output(xg)),
+                          np.asarray(fused.output(xg))):
+        print("smoke_pooling: fused forward not bitwise")
+        return 1
+    if "sibling_conv_fusion_total" not in registry().prometheus_text():
+        print("smoke_pooling: fusion counter family missing")
+        return 1
+    print("smoke_pooling: sibling-conv fusion bitwise forward ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

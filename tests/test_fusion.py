@@ -1,0 +1,242 @@
+"""Sibling-conv fusion pass (nn/graph/fusion.py, ISSUE 10): the concat
+rewrite of inception-style 1x1 branches must be exact — bitwise forward,
+gradient parity up to conv reduction reassociation — and the fused
+config must stay a first-class citizen of serde and checkpointing.
+
+The graph under test is a 2-block miniature of GoogLeNet's _inception
+(models/zoo.py): per block, three 1x1 sibling convs off one input plus
+a 3x3 follower and a pool branch merging back. Tiny shapes (8x8x6
+input) — tier-1 budget."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (ComputationGraph, InputType,
+                                NeuralNetConfiguration, Nesterovs,
+                                OutputLayer)
+from deeplearning4j_tpu.data.dataset import MultiDataSet
+from deeplearning4j_tpu.nn.conf.graph_conf import ComputationGraphConfiguration
+from deeplearning4j_tpu.nn.graph import fusion
+from deeplearning4j_tpu.nn.graph.vertices import MergeVertex, SubsetVertex
+from deeplearning4j_tpu.nn.layers.convolution import (ConvolutionLayer,
+                                                      GlobalPoolingLayer,
+                                                      PoolingType,
+                                                      SubsamplingLayer)
+from deeplearning4j_tpu.optimize.metrics import registry
+from deeplearning4j_tpu.utils import model_serializer
+
+N_CLASSES = 3
+
+
+def _inception(g, name, n1, n2, n3, inp):
+    # mirrors models/zoo.py GoogLeNet._inception at tiny widths: the
+    # three sibling 1x1s are the fusion candidates; the 3x3 follower and
+    # max-pool branch make the block's merge topology realistic.
+    g.add_layer(f"{name}-cnn1",
+                ConvolutionLayer(n_out=n1, kernel_size=(1, 1)), inp)
+    g.add_layer(f"{name}-cnn2",
+                ConvolutionLayer(n_out=n2, kernel_size=(1, 1)), inp)
+    g.add_layer(f"{name}-cnn3",
+                ConvolutionLayer(n_out=n3, kernel_size=(1, 1)), inp)
+    g.add_layer(f"{name}-cnn4",
+                ConvolutionLayer(n_out=n2, kernel_size=(3, 3),
+                                 padding=(1, 1)), f"{name}-cnn2")
+    g.add_layer(f"{name}-max1",
+                SubsamplingLayer(kernel_size=(3, 3), stride=(1, 1),
+                                 padding=(1, 1),
+                                 pooling_type=PoolingType.MAX), inp)
+    g.add_vertex(f"{name}-merge", MergeVertex(), f"{name}-cnn1",
+                 f"{name}-cnn4", f"{name}-cnn3", f"{name}-max1")
+    return f"{name}-merge"
+
+
+def tiny_inception_conf(tweak=None):
+    g = (NeuralNetConfiguration.builder().seed(7).activation("relu")
+         .updater(Nesterovs(learning_rate=1e-2, momentum=0.9)).l2(2e-4)
+         .graph_builder().add_inputs("input"))
+    x = _inception(g, "3a", 4, 3, 2, "input")
+    x = _inception(g, "3b", 3, 4, 2, x)
+    g.add_layer("pool", GlobalPoolingLayer(pooling_type=PoolingType.AVG), x)
+    g.add_layer("output", OutputLayer(n_out=N_CLASSES, activation="softmax",
+                                      loss="mcxent"), "pool")
+    g.set_outputs("output")
+    g.set_input_types(InputType.convolutional(8, 8, 6))
+    conf = g.build()
+    if tweak:
+        tweak(conf)  # post-build edits (rejection-gate scenarios)
+    return conf
+
+
+def _data(n=4, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, 8, 8, 6)).astype(np.float32)
+    y = np.eye(N_CLASSES, dtype=np.float32)[rng.integers(0, N_CLASSES, n)]
+    return x, y
+
+
+def _outputs(net, x):
+    return np.asarray(net.output(jnp.asarray(x)))
+
+
+class TestDetection:
+    def test_finds_both_blocks(self):
+        conf = tiny_inception_conf()
+        groups = fusion.find_sibling_conv_groups(conf)
+        assert [g.fused_name for g in groups] == [
+            "3a-cnn1+3a-cnn2+3a-cnn3", "3b-cnn1+3b-cnn2+3b-cnn3"]
+        assert groups[0].n_outs == (4, 3, 2)
+        assert groups[0].offsets == (0, 4, 7)
+
+    def test_rejection_gates(self):
+        # dropout on one sibling: per-node rng, whole trio stays split
+        # (the dropout branch leaves the bucket; the survivors still
+        # pair up).
+        def with_dropout(g):
+            g.nodes["3a-cnn2"].layer.dropout_rate = 0.5
+        conf = tiny_inception_conf(with_dropout)
+        names = [g.fused_name for g in
+                 fusion.find_sibling_conv_groups(conf)]
+        assert "3a-cnn1+3a-cnn2+3a-cnn3" not in names
+        assert "3a-cnn1+3a-cnn3" in names
+
+        # mixed geometry never buckets together
+        def with_geometry(g):
+            g.nodes["3a-cnn3"].layer.kernel_size = (3, 3)
+            g.nodes["3a-cnn3"].layer.padding = (1, 1)
+        conf = tiny_inception_conf(with_geometry)
+        names = [g.fused_name for g in
+                 fusion.find_sibling_conv_groups(conf)]
+        assert names == ["3a-cnn1+3a-cnn2", "3b-cnn1+3b-cnn2+3b-cnn3"]
+
+    def test_fused_conf_structure_and_serde_roundtrip(self):
+        fused, groups = fusion.fuse_sibling_convs(tiny_inception_conf())
+        assert len(groups) == 2
+        node = fused.nodes["3a-cnn1+3a-cnn2+3a-cnn3"]
+        assert node.layer.n_out == 9
+        member = fused.nodes["3a-cnn2"]
+        assert isinstance(member.vertex, SubsetVertex)
+        assert (member.vertex.from_idx, member.vertex.to_idx) == (4, 6)
+        assert member.inputs == ["3a-cnn1+3a-cnn2+3a-cnn3"]
+        rt = ComputationGraphConfiguration.from_json(fused.to_json())
+        assert rt.to_json() == fused.to_json()
+        assert rt.topo_order == fused.topo_order
+        # ComputationGraph accepts the round-tripped config
+        ComputationGraph(rt).init()
+
+
+class TestNumericalParity:
+    def _nets(self):
+        net = ComputationGraph(tiny_inception_conf()).init()
+        fused = fusion.fuse_graph(net)
+        return net, fused
+
+    def test_forward_bitwise(self):
+        net, fused = self._nets()
+        x, _ = _data()
+        assert np.array_equal(_outputs(net, x), _outputs(fused, x))
+
+    def test_gradient_parity(self):
+        """Gradients across the fusion boundary match up to conv
+        reduction reassociation (one 9-channel contraction vs three
+        small ones): measured ~1e-7 relative in f32, so tight allclose,
+        not array_equal."""
+        net, fused = self._nets()
+        x, y = _data()
+        args = ({"input": jnp.asarray(x)}, {"output": jnp.asarray(y)},
+                {}, {}, None, True)
+
+        def grads(n):
+            f = lambda p: n._loss_pure(p, n.state_tree, *args)[0]
+            return jax.grad(f)(n.params_tree)
+
+        g_unfused = fusion.fuse_params(
+            fusion.find_sibling_conv_groups(net.conf), grads(net))
+        g_fused = grads(fused)
+        for name in g_fused:
+            for leaf in g_fused[name]:
+                np.testing.assert_allclose(
+                    np.asarray(g_fused[name][leaf]),
+                    np.asarray(g_unfused[name][leaf]),
+                    rtol=5e-6, atol=1e-7,
+                    err_msg=f"{name}/{leaf}")
+
+    @pytest.mark.slow  # ~8s on the 1-core rig; parity already tier-1 via
+    # test_gradient_parity — this adds the updater-state leg
+    def test_training_trajectory(self):
+        net, fused = self._nets()
+        x, y = _data()
+        mds = MultiDataSet([x], [y])
+        for _ in range(3):
+            net.fit_batch(mds)
+            fused.fit_batch(mds)
+        np.testing.assert_allclose(_outputs(fused, x), _outputs(net, x),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_fuse_unfuse_roundtrip_bitwise(self):
+        net, _ = self._nets()
+        groups = fusion.find_sibling_conv_groups(net.conf)
+        rt = fusion.unfuse_params(groups,
+                                  fusion.fuse_params(groups,
+                                                     net.params_tree))
+        assert set(rt) == set(net.params_tree)
+        for name in rt:
+            for leaf in rt[name]:
+                assert np.array_equal(np.asarray(rt[name][leaf]),
+                                      np.asarray(net.params_tree[name][leaf]))
+
+
+class TestCheckpointBoundary:
+    def test_checkpoint_across_fused_unfused(self, tmp_path):
+        """An unfused checkpoint must restore into a fused net (and
+        back) through fuse_params/unfuse_params — the serving hot-swap
+        path when the pool turns fusion on for a model it already
+        serves."""
+        net = ComputationGraph(tiny_inception_conf()).init()
+        x, y = _data()
+        net.fit_batch(MultiDataSet([x], [y]))
+        path = str(tmp_path / "unfused.zip")
+        model_serializer.save_model(net, path)
+
+        restored = model_serializer.restore_model(path)
+        fused = fusion.fuse_graph(restored)
+        assert np.array_equal(_outputs(net, x), _outputs(fused, x))
+
+        # cross back: slice the fused params onto a fresh unfused net
+        groups = fusion.find_sibling_conv_groups(net.conf)
+        back = ComputationGraph(tiny_inception_conf()).init()
+        back.params_tree = fusion.unfuse_params(groups, fused.params_tree)
+        back.state_tree = fusion.unfuse_params(groups, fused.state_tree)
+        assert np.array_equal(_outputs(back, x), _outputs(net, x))
+
+    @pytest.mark.slow  # ~8s; the boundary crossing above is the
+    # load-bearing tier-1 check
+    def test_fused_checkpoint_roundtrip(self, tmp_path):
+        fused = fusion.fuse_graph(
+            ComputationGraph(tiny_inception_conf()).init())
+        x, y = _data()
+        fused.fit_batch(MultiDataSet([x], [y]))
+        path = str(tmp_path / "fused.zip")
+        model_serializer.save_model(fused, path)
+        restored = model_serializer.restore_model(path)
+        assert np.array_equal(_outputs(restored, x), _outputs(fused, x))
+
+
+class TestMetricsAndZoo:
+    def test_fusion_counter_on_scrape_surface(self):
+        fusion.register_metrics()
+        fusion.fuse_sibling_convs(tiny_inception_conf())
+        text = registry().prometheus_text()
+        assert "sibling_conv_fusion_total" in text
+        assert registry().counter(
+            "sibling_conv_fusion_total", "").value(outcome="fused") >= 2
+
+    def test_googlenet_knob(self):
+        from deeplearning4j_tpu.models import GoogLeNet
+        conf = GoogLeNet(num_labels=10, fuse_siblings=True).conf()
+        fused_nodes = [n for n in conf.nodes if "+" in n]
+        assert len(fused_nodes) == 9  # one per inception block
+        # original branch names survive as SubsetVertex slices
+        assert isinstance(conf.nodes["3a-cnn1"].vertex, SubsetVertex)
+        rt = ComputationGraphConfiguration.from_json(conf.to_json())
+        assert rt.topo_order == conf.topo_order
