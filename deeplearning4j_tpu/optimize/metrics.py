@@ -91,6 +91,13 @@ class _Family:
                 self._children[key] = child
             return child
 
+    def touch(self, **labels) -> "_Family":
+        """Materialize the labeled child at its zero value without
+        changing it — pre-registration, so a snapshot can distinguish
+        'this label set never fired' (exported 0) from 'this code path
+        never ran' (absent)."""
+        return self.labels(**labels)
+
     # ---- iteration over (label_key, child) incl. the bare child --------
     def _cells(self):
         with self._lock:

@@ -68,3 +68,10 @@ JAX_PLATFORMS=cpu python tests/smoke_chaos_serving.py
 # bitwise-identically — under a hard signal.alarm so a watchdog
 # regression can never wedge the gate itself.
 JAX_PLATFORMS=cpu python tests/smoke_cluster_health.py
+
+# Bench scoreboard smoke (docs/observability.md §bench-scoreboard): wedge
+# a real bench child mid-measurement via the bench.child delay fault and
+# assert the fail-safe plane holds — exit 0, the artifact parses with
+# degraded: true rows and the registry snapshot embedded, and the ledger
+# row is schema-valid. Under a hard signal.alarm like the chaos smokes.
+JAX_PLATFORMS=cpu python tests/smoke_scoreboard.py

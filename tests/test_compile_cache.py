@@ -308,6 +308,8 @@ class TestBenchSurvivability:
         and spread.n reports what actually ran — never `parsed: null`."""
         env = dict(os.environ)
         env.update(JAX_PLATFORMS="cpu", BENCH_TIME_BUDGET_S="1",
+                   DL4JTPU_BENCH_PROBE="0",
+                   DL4JTPU_BENCH_LEDGER=str(tmp_path / "ledger.jsonl"),
                    DL4JTPU_COMPILE_CACHE_DIR=str(tmp_path / "cache"))
         out = subprocess.run(
             [sys.executable, os.path.join(REPO, "bench.py"), "lenet_tiny"],
@@ -322,10 +324,14 @@ class TestBenchSurvivability:
     @pytest.mark.slow
     def test_timeout_child_emits_json_rc0(self, tmp_path):
         """A child that blows its wall limit with zero completed repeats
-        still produces a machine-readable artifact and rc 0."""
+        still produces a machine-readable artifact and rc 0 — since
+        round 11 via the in-process degraded fallback, so the row also
+        carries a real (reduced-config) measurement."""
         env = dict(os.environ)
         env.update(JAX_PLATFORMS="cpu", BENCH_TIME_BUDGET_S="1",
                    BENCH_CHILD_MIN_S="2",  # far below jax startup time
+                   DL4JTPU_BENCH_PROBE="0",
+                   DL4JTPU_BENCH_LEDGER=str(tmp_path / "ledger.jsonl"),
                    DL4JTPU_COMPILE_CACHE_DIR=str(tmp_path / "cache"))
         out = subprocess.run(
             [sys.executable, os.path.join(REPO, "bench.py"), "lenet_tiny"],
@@ -334,3 +340,5 @@ class TestBenchSurvivability:
         row = json.loads(out.stdout.strip().splitlines()[-1])
         assert row["timeout"] is True
         assert row["spread"]["n"] == 0
+        assert row["degraded"] is True
+        assert row["metrics"], "registry snapshot must ride the artifact"

@@ -5,7 +5,6 @@ sharded prefetch on the virtual mesh, compile/ETL telemetry, and the
 bench driver's partial-JSON timeout contract."""
 import json
 import queue
-import subprocess
 import sys
 import threading
 import time
@@ -244,39 +243,54 @@ class TestTelemetry:
 
 
 class TestBenchTimeout:
-    def _run_main(self, monkeypatch, capsys, runs_before_timeout):
+    def _run_main(self, monkeypatch, capsys, tmp_path,
+                  runs_before_timeout):
         import bench
+        from deeplearning4j_tpu.optimize import scoreboard
         calls = {"n": 0}
         real_json = json.dumps({"metric": "m", "value": 1.0, "unit": "u"})
 
-        class Out:
-            returncode = 0
-            stdout = real_json + "\n"
-            stderr = ""
-
-        def fake_run(*a, **kw):
+        def fake_run_child(cmd, **kw):
             calls["n"] += 1
             if calls["n"] > runs_before_timeout:
-                raise subprocess.TimeoutExpired(cmd="bench", timeout=1.0)
-            return Out()
+                return scoreboard.ChildResult(
+                    "timeout", None, "", "", 0, None, False, 1.0)
+            return scoreboard.ChildResult(
+                "ok", 0, real_json + "\n", "", 3, None, False, 1.0)
 
-        monkeypatch.setattr(bench.subprocess, "run", fake_run)
+        monkeypatch.setattr(scoreboard, "run_child", fake_run_child)
+        # the degraded fallback's in-process measurement, stubbed: this
+        # test pins the parent plumbing, not a workload
+        monkeypatch.setattr(
+            bench, "run_once",
+            lambda w, a, degraded=False: ("m", 1.0, "u",
+                                          {"degraded_config": {}}))
         monkeypatch.setattr(bench, "host_sentinel_ms", lambda n=3: (1.0, 1.0))
-        monkeypatch.setattr(bench, "_vs_baseline", lambda m, v: 1.0)
+        monkeypatch.setattr(bench, "_vs_baseline",
+                            lambda m, v, backend=None: 1.0)
         monkeypatch.setattr(sys, "argv", ["bench.py", "lenet"])
         monkeypatch.setenv("BENCH_REPEATS", "3")
         monkeypatch.setenv("BENCH_TIME_BUDGET_S", "420")
+        monkeypatch.setenv("DL4JTPU_BENCH_PROBE", "0")
+        monkeypatch.setenv("DL4JTPU_BENCH_LEDGER",
+                           str(tmp_path / "ledger.jsonl"))
         bench.main()  # must NOT raise SystemExit
         return json.loads(capsys.readouterr().out.strip().splitlines()[-1])
 
-    def test_first_child_timeout_emits_partial_json_exit_zero(
-            self, monkeypatch, capsys):
-        row = self._run_main(monkeypatch, capsys, runs_before_timeout=0)
+    def test_first_child_timeout_falls_back_degraded(
+            self, monkeypatch, capsys, tmp_path):
+        row = self._run_main(monkeypatch, capsys, tmp_path,
+                             runs_before_timeout=0)
         assert row["timeout"] is True
         assert row["spread"]["n"] == 0
+        assert row["degraded"] is True
+        assert row["value"] == 1.0
+        assert "metrics" in row  # registry snapshot rides the artifact
 
-    def test_partial_repeats_marked_timeout(self, monkeypatch, capsys):
-        row = self._run_main(monkeypatch, capsys, runs_before_timeout=2)
+    def test_partial_repeats_marked_timeout(self, monkeypatch, capsys,
+                                            tmp_path):
+        row = self._run_main(monkeypatch, capsys, tmp_path,
+                             runs_before_timeout=2)
         assert row["timeout"] is True
         assert row["spread"]["n"] == 2
         assert row["value"] == 1.0
