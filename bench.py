@@ -453,6 +453,26 @@ def bench_attention_ab(seq_len=4096, width=512, heads=4, steps=3,
         if best is None or tps > best[1]:
             best = (name, tps)
     extras["winner"] = best[0]
+    if "pallas" in impls:
+        # Satellite A/B (ISSUE 13): bf16 backward accumulators vs the
+        # f32 default — max-abs gradient drift across dq/dk/dv at this
+        # geometry (the bwd_acc_dtype knob's standing honesty row;
+        # docs/perf_attention.md records the measured number).
+        def acc_grads(dt_name):
+            def loss(q, k, v):
+                return jnp.sum(fa.flash_attention(
+                    q, k, v, causal=True,
+                    bwd_acc_dtype=dt_name).astype(jnp.float32)
+                    * g.astype(jnp.float32))
+            return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+
+        _beat(phase="acc_ab")
+        g32 = jax.block_until_ready(acc_grads("float32"))
+        g16 = jax.block_until_ready(acc_grads("bfloat16"))
+        drift = max(float(jnp.max(jnp.abs(
+            a.astype(jnp.float32) - b.astype(jnp.float32))))
+            for a, b in zip(g32, g16))
+        extras["bwd_acc_bf16_max_grad_drift"] = round(drift, 6)
     return best[1], extras
 
 
@@ -510,6 +530,101 @@ def bench_attention_longctx(seq_len=8192, width=512, heads=4, steps=5,
     return tps, {"batch": batch, "seq_len": seq_len,
                  "attention_impl": picked,
                  "est_mfu": round(tps * fpt / TPU_V5E_BF16_PEAK, 3)}
+
+
+def bench_attention_packed(bucket=4096, n_seqs=32, width=512, heads=4,
+                           steps=3, repeats=3):
+    """Packed vs padded varlen training tokens/sec (ISSUE 13): ragged
+    lognormal-length sequences (median ~30% of the bucket, capped at
+    bucket) trained two ways at the SAME canonical [rows, bucket] shape —
+    one-sequence-per-row zero-padding with a key mask, vs first-fit
+    packing with in-kernel segment masks (data/padding.pack_sequences +
+    SelfAttentionLayer packed_segments). Both arms step on the SAME real
+    tokens under the rank-2 zero-weight loss contract, so tokens/sec =
+    real_tokens/wall and the ratio is pure density win: packing needs
+    ~utilization x n_seqs rows instead of n_seqs. The headline value is
+    the PACKED arm; extras carry the padded arm, the speedup, and the
+    utilization so the ratio is interpretable."""
+    import math
+
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                    NeuralNetConfiguration, RnnOutputLayer,
+                                    Sgd)
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.data.padding import (first_fit_pack,
+                                                 pack_sequences)
+    from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer
+
+    vocab = 96
+    rng = np.random.default_rng(0)
+    # Ragged real-corpus-ish lengths: lognormal with median 30% of the
+    # bucket, sigma 0.8, clipped to [8, bucket] — mean utilization lands
+    # ~35-45%, the regime packing exists for.
+    lengths = np.clip(rng.lognormal(math.log(bucket * 0.3), 0.8,
+                                    n_seqs).astype(np.int64),
+                      8, bucket).astype(np.int32)
+    idx = rng.integers(0, vocab, (n_seqs, bucket))
+    eye = np.eye(vocab, dtype=np.float32)
+    feats = eye[idx]
+    labels = eye[np.roll(idx, -1, 1)]
+    t_idx = np.arange(bucket)[None, :]
+    key_mask = (t_idx < lengths[:, None]).astype(np.float32)
+    feats = feats * key_mask[..., None]
+    labels = labels * key_mask[..., None]
+    real_tokens = int(lengths.sum())
+
+    def mk_net(packed):
+        conf = (NeuralNetConfiguration.builder().seed(0)
+                .updater(Sgd(0.1)).list()
+                .layer(SelfAttentionLayer(n_out=width, n_heads=heads,
+                                          causal=True, activation="relu",
+                                          packed_segments=packed))
+                .layer(SelfAttentionLayer(n_out=width, n_heads=heads,
+                                          causal=True, activation="relu",
+                                          packed_segments=packed))
+                .layer(RnnOutputLayer(n_out=vocab, activation="softmax",
+                                      loss="mcxent"))
+                .set_input_type(InputType.recurrent(vocab))
+                .build())
+        return MultiLayerNetwork(conf).init(dtype=jnp.bfloat16)
+
+    def arm(name, net, ds):
+        _beat(phase=f"arm_{name}")
+        dt = _measure(lambda: net.fit_batch_repeated(ds, steps),
+                      lambda: float(net.score_value), repeats)
+        return real_tokens * steps / dt
+
+    # Padded arm: one sequence per row, zero-weight pad tail.
+    padded_ds = DataSet(
+        jax.device_put(jnp.asarray(feats, jnp.bfloat16)),
+        jax.device_put(jnp.asarray(labels)),
+        jax.device_put(jnp.asarray(key_mask)),
+        jax.device_put(jnp.asarray(key_mask)))
+    padded_tps = arm("padded", mk_net(False), padded_ds)
+
+    # Packed arm: first-fit into segment-masked rows, same real tokens.
+    bins = first_fit_pack(lengths, bucket)
+    pf, pl, seg, lm, _pos = pack_sequences(feats, labels, lengths, bucket,
+                                           bins=bins)
+    packed_ds = DataSet(
+        jax.device_put(jnp.asarray(pf, jnp.bfloat16)),
+        jax.device_put(jnp.asarray(pl)),
+        jax.device_put(jnp.asarray(seg)),
+        jax.device_put(jnp.asarray(lm)))
+    packed_tps = arm("packed", mk_net(True), packed_ds)
+
+    util = real_tokens / float(n_seqs * bucket)
+    return packed_tps, {
+        "bucket": bucket,
+        "n_seqs": n_seqs,
+        "rows_packed": len(bins),
+        "mean_utilization": round(util, 3),
+        "pack_fill": round(real_tokens / float(len(bins) * bucket), 3),
+        "padded_tokens_per_sec": round(padded_tps, 1),
+        "packed_vs_padded": round(packed_tps / padded_tps, 2),
+    }
 
 
 def bench_lstm(batch=128, seq_len=64, steps=30, repeats=3):
@@ -661,6 +776,71 @@ def bench_lenet_hostfed(batch=2048, n_train=8192, epochs=2):
         shutil.rmtree(d, ignore_errors=True)
 
 
+def _bench_serving_packed(clients=4, requests_per_client=25, bucket=128):
+    """Companion measurement for the serving row: a tiny packed_segments
+    attention model behind packed admission (parallel/inference.py),
+    ragged [1, 4..32] requests coalescing into one segment-masked
+    [1, bucket] row. Returns the extras block (rps + the packing
+    counters/efficiency the observability satellite pre-registers)."""
+    import queue as _queue
+    import threading
+    from deeplearning4j_tpu import (Adam, InputType, MultiLayerNetwork,
+                                    NeuralNetConfiguration, RnnOutputLayer)
+    from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer
+    from deeplearning4j_tpu.parallel.inference import ParallelInference
+
+    feat = 8
+    conf = (NeuralNetConfiguration.builder().seed(7).updater(Adam(1e-3))
+            .list()
+            .layer(SelfAttentionLayer(n_out=8, n_heads=2, causal=True,
+                                      packed_segments=True))
+            .layer(RnnOutputLayer(n_out=4, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(InputType.recurrent(feat)).build())
+    net = MultiLayerNetwork(conf).init()
+    pi = ParallelInference(net, batch_limit=8, batch_timeout_ms=2.0,
+                           queue_limit=1024, packed_admission=True,
+                           pack_bucket=bucket)
+    pi.warmup(max_bucket=1, time_steps=bucket)
+    rng = np.random.default_rng(1)
+    payloads = [rng.standard_normal((1, 4 + (i % 29), feat))
+                .astype(np.float32) for i in range(16)]
+    errors: "_queue.Queue" = _queue.Queue()
+
+    def client(ci):
+        try:
+            for j in range(requests_per_client):
+                pi.output(payloads[(ci + j) % len(payloads)])
+        except Exception as e:
+            errors.put(e)
+
+    pi.output(payloads[0])  # seed the EWMA off the clock
+    t0 = time.perf_counter()
+    ts = [threading.Thread(target=client, args=(i,))
+          for i in range(clients)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    dt = time.perf_counter() - t0
+    if not errors.empty():
+        raise errors.get()
+    from deeplearning4j_tpu.optimize.metrics import registry as _reg
+    eff = _reg().gauge("packing_efficiency").value(source="serve")
+    out = {
+        "requests_per_sec": round(clients * requests_per_client / dt, 1),
+        "pack_bucket": bucket,
+        "packed_requests": pi.total_packed_requests,
+        "pack_fallbacks": pi.total_pack_fallbacks,
+        "forwards": pi.total_forwards,
+        "requests_per_forward": round(
+            pi.total_packed_requests / max(1, pi.total_forwards), 2),
+        "packing_efficiency": round(eff, 3),
+    }
+    pi.shutdown()
+    return out
+
+
 def bench_serving(clients=8, requests_per_client=200, batch_limit=8):
     """Serving gateway requests/sec (docs/serving.md): concurrent
     clients with mixed 1-5 row payloads through the continuous-batching
@@ -742,6 +922,9 @@ def bench_serving(clients=8, requests_per_client=200, batch_limit=8):
         "swaps_canary_rejected": int(reg.counter(
             "serving_swaps_total").value(model="default",
                                          outcome="canary_rejected")),
+        # Packed-admission companion row (docs/serving.md §packed):
+        # short ragged requests through a segment-masked packed row.
+        "serving_packed": _bench_serving_packed(),
     }
 
 
@@ -803,6 +986,7 @@ _DEGRADED_KW = {
     "attention": dict(batch=8, seq_len=128, steps=2, repeats=1),
     "attention_longctx": dict(steps=2, repeats=1),
     "attention_ab": dict(steps=1, repeats=1),
+    "attention_packed": dict(bucket=512, n_seqs=16, steps=1, repeats=1),
     "lstm": dict(batch=32, seq_len=32, steps=5, repeats=1),
     "w2v": dict(vocab=5_000, sentences=500),
     "etl": dict(n_images=128, epochs=1),
@@ -901,6 +1085,12 @@ def _dispatch_once(workload: str, arg, kw):
         tps, ext = bench_attention_ab(seq_len=seq, **kw)
         return (f"attention_ab_seq{seq}_tokens_per_sec", tps,
                 "tokens/sec", ext)
+    if workload == "attention_packed":
+        kw.setdefault("bucket", int(arg) if arg else 4096)
+        bucket = kw["bucket"]
+        tps, ext = bench_attention_packed(**kw)
+        return (f"attention_packed_seq{bucket}_tokens_per_sec", tps,
+                "tokens/sec", ext)
     if workload == "resnet50":
         kw.setdefault("batch", int(arg) if arg else 1024)
         ips = bench_resnet50(**kw)
@@ -917,7 +1107,7 @@ def _dispatch_once(workload: str, arg, kw):
         f"Unknown workload {workload!r}; use resnet50 [batch] | vgg16 | "
         "googlenet | googlenet_pool_ab [batch] | attention | "
         "attention_longctx [seq] | "
-        "attention_ab [seq] | alexnet | "
+        "attention_ab [seq] | attention_packed [bucket] | alexnet | "
         "alexnet_pallaslrn | lenet | lenet_tiny | lstm | w2v [scale] | "
         "etl | lenet_hostfed | serving | check [metric...] | report")
 
@@ -927,6 +1117,7 @@ def _register_metric_families():
     snapshots distinguish "never fired" from "absent". Shared by the
     --once child and the parent's degraded fallback (which embeds a
     snapshot exactly as the healthy path does)."""
+    from deeplearning4j_tpu.data import padding as data_padding
     from deeplearning4j_tpu.nn.graph import fusion as graph_fusion
     from deeplearning4j_tpu.ops import pooling as pooling_ops
     from deeplearning4j_tpu.optimize import resilience, scoreboard
@@ -945,6 +1136,7 @@ def _register_metric_families():
     pooling_ops.register_metrics()
     graph_fusion.register_metrics()
     scoreboard.register_metrics()
+    data_padding.register_packing_metrics()
 
 
 def _append_ledger(row):

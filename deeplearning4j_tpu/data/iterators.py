@@ -520,6 +520,134 @@ class PadToBucketIterator(DataSetIterator):
         return base_ok()
 
 
+class PackToBucketIterator(DataSetIterator):
+    """Pack ragged sequences MULTIPLE-per-row instead of padding each to
+    its own row (the varlen/segment-mask sibling of PadToBucketIterator;
+    docs/perf_data_pipeline.md §PackToBucket): every emitted batch has
+    the one canonical ``(rows, bucket_len)`` shape — ONE compiled train
+    step per epoch — but the time axis is dense with real tokens, so at
+    ragged length mixes the same step processes 2-3x the real tokens of
+    the padded layout.
+
+    The emitted feature mask carries SEGMENT IDS (0 = pad, 1..k = the
+    k sequences sharing the row); an attention layer with
+    ``packed_segments=True`` reads them through the ordinary mask
+    plumbing and forbids cross-segment attention, so per-token outputs
+    match the unpacked batch exactly. The labels mask is the rank-2
+    zero-weight contract (data/padding.py): loss numerator AND
+    denominator (sum(mask) = real tokens) are identical to training on
+    the unpacked ragged batch — loss-exact, not approximately so.
+    Per-segment 0-based positions ride along as ``packed_positions``
+    for position-consuming consumers (attention itself needs only ids).
+
+    `bucket_len` defaults to the pow2 bucket of the first batch's
+    longest sequence (the shared next_pow2_bucket rule); `rows` defaults
+    to the first batch's first-fit bin count. Later batches that need
+    more bins split into several emitted packed batches (same shape);
+    leftover bins pad with fully-masked all-zero rows. A sequence longer
+    than `bucket_len` raises — choose the bucket for the corpus.
+
+    Requires [batch, time, features] features and per-timestep rank-3
+    labels; lengths come from the batch's features_mask row sums (a
+    maskless batch packs as full-length rows). Masks must be contiguous
+    from t=0 — mid-sequence holes have no packed representation."""
+
+    def __init__(self, base, bucket_len: Optional[int] = None,
+                 rows: Optional[int] = None):
+        self._base = base
+        self._fixed_bucket = bucket_len
+        self._fixed_rows = rows
+        self._bucket = bucket_len
+        self._rows = rows
+        self._it: Optional[Iterator] = None
+        self._pending: List[DataSet] = []
+
+    def reset(self):
+        self._it = iter(self._base)
+        self._bucket = self._fixed_bucket
+        self._rows = self._fixed_rows
+        self._pending = []
+
+    def __iter__(self):
+        self.reset()
+        return self
+
+    def _lengths(self, ds: DataSet, n: int, t: int) -> np.ndarray:
+        if ds.features_mask is None:
+            return np.full(n, t, dtype=np.int64)
+        fm = np.asarray(ds.features_mask) > 0
+        lengths = fm.sum(axis=1).astype(np.int64)
+        contiguous = np.arange(t)[None, :] < lengths[:, None]
+        if not np.array_equal(fm, contiguous):
+            raise ValueError(
+                "PackToBucketIterator needs contiguous-from-start "
+                "feature masks (no mid-sequence holes)")
+        return lengths
+
+    def _pack_batch(self, ds: DataSet) -> List[DataSet]:
+        from .padding import (first_fit_pack, next_pow2_bucket,
+                              pack_sequences, record_packing)
+        f = np.asarray(ds.features)
+        if f.ndim != 3:
+            raise ValueError(
+                "PackToBucketIterator needs [batch, time, features] "
+                f"features, got shape {f.shape}")
+        lab = np.asarray(ds.labels)
+        if lab.ndim != 3:
+            raise ValueError(
+                "PackToBucketIterator needs per-timestep (rank-3) "
+                f"labels, got shape {lab.shape}")
+        n, t = f.shape[0], f.shape[1]
+        lengths = self._lengths(ds, n, t)
+        if self._bucket is None:
+            self._bucket = next_pow2_bucket(int(lengths.max()))
+        lmask = None if ds.labels_mask is None \
+            else np.asarray(ds.labels_mask)
+        if lmask is not None and lmask.ndim != 2:
+            raise ValueError(
+                "PackToBucketIterator needs a per-token rank-2 labels "
+                f"mask, got shape {lmask.shape}")
+        bins = first_fit_pack(lengths, self._bucket)
+        if self._rows is None:
+            self._rows = len(bins)
+        out: List[DataSet] = []
+        for c0 in range(0, len(bins), self._rows):
+            chunk = bins[c0:c0 + self._rows]
+            pf, pl, seg, plm, pos = pack_sequences(
+                f, lab, lengths, self._bucket, bins=chunk,
+                rows=self._rows, labels_mask=lmask)
+            packed = DataSet(pf, pl, seg, plm)
+            try:
+                packed.packed_positions = pos
+            except AttributeError:
+                pass
+            out.append(packed)
+            record_packing(
+                "fit", items=sum(len(b) for b in chunk),
+                real_tokens=int(sum(int(lengths[i])
+                                    for b in chunk for i in b)),
+                padded_tokens=self._rows * self._bucket)
+        return out
+
+    def __next__(self) -> DataSet:
+        if self._it is None:
+            self.reset()
+        while not self._pending:
+            self._pending = self._pack_batch(next(self._it))
+        return self._maybe_preprocess(self._pending.pop(0))
+
+    def batch_size(self):
+        return self._rows
+
+    def total_examples(self):
+        return self._base.total_examples() \
+            if hasattr(self._base, "total_examples") else None
+
+    def async_supported(self) -> bool:
+        base_ok = getattr(self._base, "async_supported", lambda: True)
+        return base_ok()
+
+
 class DevicePrefetchIterator(AsyncDataSetIterator):
     """Background prefetch that stages batches ONTO THE DEVICE: the
     producer thread runs `jax.device_put` (with an optional

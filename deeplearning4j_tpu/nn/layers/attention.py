@@ -50,6 +50,15 @@ class SelfAttentionLayer(Layer):
     # when the kernel is unavailable). The ring path picks its own
     # fused inner step (ring_self_attention use_flash auto).
     attention_impl: str = "auto"
+    # Packed-batch mode (docs/perf_data_pipeline.md §PackToBucket): the
+    # feature mask carries SEGMENT IDS instead of a 0/1 key mask — 0 is
+    # still padding, 1..k number the sequences packed into each row.
+    # Attention masks key padding (mask > 0, unchanged semantics) AND
+    # forbids cross-segment pairs (segment-equality term in every impl).
+    # Off by default: a plain 0/1 mask behaves identically either way
+    # (all real tokens share segment 1), but the knob keeps the
+    # segment-equality compare out of unpacked traces.
+    packed_segments: bool = False
 
     def input_kind(self):
         return "rnn"
@@ -110,9 +119,17 @@ class SelfAttentionLayer(Layer):
         q = (x @ params[W_Q] + params[B_Q]).reshape(b, t, h, d)
         k = (x @ params[W_K] + params[B_K]).reshape(b, t, h, d)
         v = (x @ params[W_V] + params[B_V]).reshape(b, t, h, d)
+        seg = None
+        if self.packed_segments and mask is not None:
+            seg = mask.astype(jnp.int32)
         sp = active_sequence_parallel()
         use_ring = False
         if sp is not None:
+            if seg is not None:
+                raise ValueError(
+                    "packed_segments is a single-device mode; it does "
+                    "not compose with sequence_parallel (the ring has "
+                    "no segment operand)")
             seq_shards = int(sp[0].shape[sp[1]])
             use_ring = t % seq_shards == 0
             if not use_ring and not getattr(
@@ -165,12 +182,16 @@ class SelfAttentionLayer(Layer):
             # counter (ops.attention.select_attention_impl)
             out = single_device_attention(
                 q, k, v, causal=self.causal, key_mask=mask,
+                segment_ids=seg,
                 impl=self.attention_impl, block_size=self.block_size)
         out = out.reshape(b, t, self.n_out)
         out = out @ params[W_O] + params[B_O]
         out = self._act()(out)
         if mask is not None:
             # zero masked timesteps POST-activation (the recurrent-layer
-            # convention: padded steps output exactly 0)
-            out = out * mask[..., None].astype(out.dtype)
+            # convention: padded steps output exactly 0). In packed mode
+            # the mask holds segment IDS (1..k), so binarize — scaling by
+            # the id would corrupt every segment past the first.
+            zm = (mask > 0) if seg is not None else mask
+            out = out * zm[..., None].astype(out.dtype)
         return out, state

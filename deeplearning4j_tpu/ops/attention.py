@@ -164,6 +164,7 @@ def select_attention_impl(t_q: int, head_dim: int, *,
 
 def single_device_attention(q, k, v, *, causal: bool = False,
                             key_mask: Optional[jax.Array] = None,
+                            segment_ids: Optional[jax.Array] = None,
                             impl: Optional[str] = None,
                             block_size: int = 0,
                             interpret: bool = False) -> jax.Array:
@@ -171,26 +172,36 @@ def single_device_attention(q, k, v, *, causal: bool = False,
     fused Pallas flash kernel, blockwise, or dense per
     select_attention_impl. Same signature/semantics as dense_attention
     plus the routing knobs; SelfAttentionLayer's single-chip path calls
-    this."""
+    this. `segment_ids` ([batch, time] int) enables packed-batch
+    attention — every impl applies the identical segment-equality mask,
+    so the dispatch choice never changes the math."""
     choice = select_attention_impl(q.shape[1], q.shape[-1],
                                    requested=impl, block_size=block_size,
                                    interpret=interpret, t_k=k.shape[1])
     if choice == "pallas":
         from .flash_attention import flash_attention
         return flash_attention(q, k, v, causal=causal, key_mask=key_mask,
+                               segment_ids=segment_ids,
                                interpret=interpret)
     if choice == "blockwise":
         blk = pick_block_size(q.shape[1], block_size)
         return blockwise_attention(q, k, v, causal=causal,
-                                   key_mask=key_mask, q_block=blk,
+                                   key_mask=key_mask,
+                                   segment_ids=segment_ids, q_block=blk,
                                    kv_block=blk)
-    return dense_attention(q, k, v, causal=causal, key_mask=key_mask)
+    return dense_attention(q, k, v, causal=causal, key_mask=key_mask,
+                           segment_ids=segment_ids)
 
 
 def dense_attention(q, k, v, *, causal: bool = False,
-                    key_mask: Optional[jax.Array] = None) -> jax.Array:
+                    key_mask: Optional[jax.Array] = None,
+                    segment_ids: Optional[jax.Array] = None,
+                    kv_segment_ids: Optional[jax.Array] = None) -> jax.Array:
     """Plain softmax attention. q/k/v: [batch, time, heads, head_dim];
-    key_mask: [batch, time_k] 1.0 = real key. f32 softmax accumulation."""
+    key_mask: [batch, time_k] 1.0 = real key; segment_ids:
+    [batch, time_q] int packed-batch ids (attention masked where q and
+    kv ids differ; kv_segment_ids defaults to segment_ids). f32 softmax
+    accumulation."""
     d = q.shape[-1]
     # accumulate in at LEAST f32, but never demote f64 (gradient checks
     # and x64 runs must keep full precision)
@@ -203,6 +214,15 @@ def dense_attention(q, k, v, *, causal: bool = False,
         scores = jnp.where(mask[None, None], scores, NEG)
     if key_mask is not None:
         scores = jnp.where(key_mask[:, None, None, :] > 0, scores, NEG)
+    if segment_ids is not None:
+        q_seg = jnp.asarray(segment_ids, jnp.int32)
+        k_seg = (q_seg if kv_segment_ids is None
+                 else jnp.asarray(kv_segment_ids, jnp.int32))
+        scores = jnp.where(
+            q_seg[:, None, :, None] == k_seg[:, None, None, :],
+            scores, NEG)
+    elif kv_segment_ids is not None:
+        raise ValueError("kv_segment_ids requires segment_ids")
     p = jax.nn.softmax(scores, axis=-1)
     # a query with NO valid keys (all masked) outputs ZERO, not the
     # uniform average softmax would produce over the NEG sentinels —
@@ -214,6 +234,7 @@ def dense_attention(q, k, v, *, causal: bool = False,
 
 def blockwise_attention(q, k, v, *, causal: bool = False,
                         key_mask: Optional[jax.Array] = None,
+                        segment_ids: Optional[jax.Array] = None,
                         q_block: int = 1024,
                         kv_block: int = 1024) -> jax.Array:
     """Memory-efficient (flash-style) attention on ONE device: identical
@@ -230,9 +251,11 @@ def blockwise_attention(q, k, v, *, causal: bool = False,
     pass recomputes block scores instead of saving them, which is what
     keeps TRAINING memory sub-quadratic too.
 
-    q/k/v: [batch, time, heads, head_dim]; key_mask: [batch, time_k].
-    Requires time % q_block == 0 and time % kv_block == 0 (callers fall
-    back to dense_attention otherwise)."""
+    q/k/v: [batch, time, heads, head_dim]; key_mask: [batch, time_k];
+    segment_ids: [batch, time] int packed-batch ids (same semantics as
+    dense_attention). Requires time % q_block == 0 and
+    time % kv_block == 0 (callers fall back to dense_attention
+    otherwise)."""
     b, t, h, d = q.shape
     if t % q_block or t % kv_block:
         raise ValueError(f"time {t} must divide q_block={q_block} and "
@@ -243,14 +266,22 @@ def blockwise_attention(q, k, v, *, causal: bool = False,
     kb = k.reshape(b, nk, kv_block, h, d)
     vb = v.reshape(b, nk, kv_block, h, d)
     kmb = None if key_mask is None else key_mask.reshape(b, nk, kv_block)
+    if segment_ids is None:
+        sqb = skb = None
+    else:
+        seg = jnp.asarray(segment_ids, jnp.int32)
+        if seg.ndim == 1:
+            seg = jnp.broadcast_to(seg[None, :], (b, t))
+        sqb = seg.reshape(b, nq, q_block)
+        skb = seg.reshape(b, nk, kv_block)
 
-    def kv_step(qi, q_pos0):
+    def kv_step(qi, q_pos0, qseg_i):
         """Scan body over kv blocks for one q block (checkpointed)."""
 
         @jax.checkpoint
         def body(carry, blk):
             m, l, o = carry
-            k_blk, v_blk, km_blk, kv_pos0 = blk
+            k_blk, v_blk, km_blk, ks_blk, kv_pos0 = blk
             scores = jnp.einsum("bqhd,bkhd->bhqk", qi, k_blk.astype(acc))
             if causal:
                 q_pos = q_pos0 + jnp.arange(q_block)
@@ -260,6 +291,9 @@ def blockwise_attention(q, k, v, *, causal: bool = False,
             if km_blk is not None:
                 scores = jnp.where(km_blk[:, None, None, :] > 0, scores,
                                    NEG)
+            if ks_blk is not None:
+                same = qseg_i[:, :, None] == ks_blk[:, None, :]
+                scores = jnp.where(same[:, None], scores, NEG)
             s_max = scores.max(-1)
             new_m = jnp.maximum(m, s_max)
             corr = jnp.exp(m - new_m)
@@ -282,17 +316,27 @@ def blockwise_attention(q, k, v, *, causal: bool = False,
         init = (jnp.full((b, h, q_block), NEG, acc),
                 jnp.zeros((b, h, q_block), acc),
                 jnp.zeros((b, h, q_block, d), acc))
-        xs = (jnp.swapaxes(kb[:, :hi], 0, 1),
-              jnp.swapaxes(vb[:, :hi], 0, 1),
-              None if kmb is None else jnp.swapaxes(kmb[:, :hi], 0, 1),
-              jnp.arange(hi) * kv_block)
-        if kmb is None:
-            xs = (xs[0], xs[1], xs[3])
-            body = kv_step(qi, q_pos0)
-            wrap = lambda c, x: body(c, (x[0], x[1], None, x[2]))
-        else:
-            wrap = kv_step(qi, q_pos0)
-        (m, l, o), _ = jax.lax.scan(wrap, init, xs)
+        # The scan xs carry only the arrays that exist; `wrap` splices
+        # Nones back into the fixed body slot order (scan xs must be
+        # arrays, not Nones).
+        parts = [jnp.swapaxes(kb[:, :hi], 0, 1),
+                 jnp.swapaxes(vb[:, :hi], 0, 1)]
+        if kmb is not None:
+            parts.append(jnp.swapaxes(kmb[:, :hi], 0, 1))
+        if skb is not None:
+            parts.append(jnp.swapaxes(skb[:, :hi], 0, 1))
+        parts.append(jnp.arange(hi) * kv_block)
+        body = kv_step(qi, q_pos0, None if sqb is None else sqb[:, i])
+        has_km, has_seg = kmb is not None, skb is not None
+
+        def wrap(c, x, body=body, has_km=has_km, has_seg=has_seg):
+            it = iter(x)
+            k_x, v_x = next(it), next(it)
+            km_x = next(it) if has_km else None
+            ks_x = next(it) if has_seg else None
+            return body(c, (k_x, v_x, km_x, ks_x, next(it)))
+
+        (m, l, o), _ = jax.lax.scan(wrap, init, tuple(parts))
         out = o / jnp.maximum(l, 1e-30)[..., None]
         outs.append(jnp.transpose(out, (0, 2, 1, 3)))
     return jnp.concatenate(outs, axis=1).astype(q.dtype)

@@ -81,8 +81,15 @@ def pick_kernel_block(t: int, want: int) -> int:
 # causal compare broadcasts to [qb, kb] without an in-kernel transpose.
 # ---------------------------------------------------------------------------
 
-def _scores(q_ref, k_ref, qp_ref, kp_ref, km_ref, scale, causal, use_mask):
-    """s = scale * q @ k^T with causal/key masking applied. f32."""
+def _scores(q_ref, k_ref, qp_ref, kp_ref, km_ref, qs_ref, ks_ref, scale,
+            causal, use_mask, use_segs):
+    """s = scale * q @ k^T with causal/key/segment masking applied. f32.
+
+    Segment masking reuses the position-array layout: q segments are a
+    [qb, 1] column block and kv segments a [1, kb] row block, so the
+    equality compare broadcasts to [qb, kb] without a transpose — the
+    varlen/packed-batch mask (multiple documents per row; cross-segment
+    attention forbidden)."""
     s = jax.lax.dot_general(
         q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale
@@ -90,26 +97,43 @@ def _scores(q_ref, k_ref, qp_ref, kp_ref, km_ref, scale, causal, use_mask):
         s = jnp.where(kp_ref[:] <= qp_ref[:], s, NEG)
     if use_mask:
         s = jnp.where(km_ref[:] > 0, s, NEG)
+    if use_segs:
+        s = jnp.where(qs_ref[0] == ks_ref[0], s, NEG)
     return s
 
 
-def _causal_when(causal, qp_ref, kp_ref, q_block, body):
-    """Run `body` — under a block-skip predicate when causal. The whole
-    KV block is strictly above the diagonal iff min(kv_pos) > max(q_pos);
-    positions are traced data, so this is a runtime `pl.when`, not a
-    trace-time grid trim (the ring path's offsets are traced)."""
+def _skip_when(causal, use_segs, qp_ref, kp_ref, qs_ref, ks_ref, q_block,
+               body):
+    """Run `body` — under a block-skip predicate when causal and/or
+    segment-masked. Causal: the whole KV block is strictly above the
+    diagonal iff min(kv_pos) > max(q_pos); positions are traced data, so
+    this is a runtime `pl.when`, not a trace-time grid trim (the ring
+    path's offsets are traced). Segments: a tile contributes nothing
+    when the q tile's segment-id RANGE cannot intersect the kv tile's —
+    conservative for arbitrary ids, exact for the packed case (ids
+    monotone within a row), and it skips every fully-cross-segment tile
+    of a packed batch."""
     from jax.experimental import pallas as pl
 
+    pred = None
     if causal:
-        @pl.when(kp_ref[0, 0] <= qp_ref[q_block - 1, 0])
+        pred = kp_ref[0, 0] <= qp_ref[q_block - 1, 0]
+    if use_segs:
+        qs, ks = qs_ref[0], ks_ref[0]
+        seg_pred = (jnp.min(ks) <= jnp.max(qs)) & \
+            (jnp.max(ks) >= jnp.min(qs))
+        pred = seg_pred if pred is None else pred & seg_pred
+    if pred is not None:
+        @pl.when(pred)
         def _():
             body()
     else:
         body()
 
 
-def _fwd_kernel(qp_ref, kp_ref, km_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-                m_ref, l_ref, acc_ref, *, scale, causal, use_mask, nk):
+def _fwd_kernel(qp_ref, kp_ref, km_ref, qs_ref, ks_ref, q_ref, k_ref, v_ref,
+                o_ref, lse_ref, m_ref, l_ref, acc_ref, *, scale, causal,
+                use_mask, use_segs, nk):
     from jax.experimental import pallas as pl
 
     j = pl.program_id(2)  # kv block index (innermost)
@@ -121,8 +145,8 @@ def _fwd_kernel(qp_ref, kp_ref, km_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         acc_ref[:] = jnp.zeros(acc_ref.shape, acc_ref.dtype)
 
     def compute():
-        s = _scores(q_ref, k_ref, qp_ref, kp_ref, km_ref, scale, causal,
-                    use_mask)
+        s = _scores(q_ref, k_ref, qp_ref, kp_ref, km_ref, qs_ref, ks_ref,
+                    scale, causal, use_mask, use_segs)
         m_prev, l_prev = m_ref[:], l_ref[:]
         m_next = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         alpha = jnp.exp(m_prev - m_next)
@@ -136,7 +160,8 @@ def _fwd_kernel(qp_ref, kp_ref, km_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
             preferred_element_type=jnp.float32)
         acc_ref[:] = acc_ref[:] * alpha + pv
 
-    _causal_when(causal, qp_ref, kp_ref, q_ref.shape[1], compute)
+    _skip_when(causal, use_segs, qp_ref, kp_ref, qs_ref, ks_ref,
+               q_ref.shape[1], compute)
 
     @pl.when(j == nk - 1)
     def _():
@@ -149,20 +174,21 @@ def _fwd_kernel(qp_ref, kp_ref, km_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0] = jnp.where(l > 0, m + jnp.log(safe), NEG)
 
 
-def _recompute_p(q_ref, k_ref, qp_ref, kp_ref, km_ref, lse_ref, scale,
-                 causal, use_mask):
+def _recompute_p(q_ref, k_ref, qp_ref, kp_ref, km_ref, qs_ref, ks_ref,
+                 lse_ref, scale, causal, use_mask, use_segs):
     """Rebuild the probability block from the lse residual; guard
     fully-masked rows (lse == NEG sentinel) to exact zeros."""
-    s = _scores(q_ref, k_ref, qp_ref, kp_ref, km_ref, scale, causal,
-                use_mask)
+    s = _scores(q_ref, k_ref, qp_ref, kp_ref, km_ref, qs_ref, ks_ref,
+                scale, causal, use_mask, use_segs)
     lse = lse_ref[0]  # [qb, 1]
     p = jnp.where(lse <= NEG / 2, 0.0, jnp.exp(s - lse))
     return p
 
 
-def _bwd_dkv_kernel(qp_ref, kp_ref, km_ref, q_ref, k_ref, v_ref, do_ref,
-                    lse_ref, di_ref, gl_ref, dk_ref, dv_ref,
-                    dk_acc, dv_acc, *, scale, causal, use_mask, nq):
+def _bwd_dkv_kernel(qp_ref, kp_ref, km_ref, qs_ref, ks_ref, q_ref, k_ref,
+                    v_ref, do_ref, lse_ref, di_ref, gl_ref, dk_ref, dv_ref,
+                    dk_acc, dv_acc, *, scale, causal, use_mask, use_segs,
+                    nq, acc_dtype):
     from jax.experimental import pallas as pl
 
     jq = pl.program_id(2)  # q block index (innermost; KV block is parallel)
@@ -173,23 +199,29 @@ def _bwd_dkv_kernel(qp_ref, kp_ref, km_ref, q_ref, k_ref, v_ref, do_ref,
         dv_acc[:] = jnp.zeros(dv_acc.shape, dv_acc.dtype)
 
     def compute():
-        p = _recompute_p(q_ref, k_ref, qp_ref, kp_ref, km_ref, lse_ref,
-                         scale, causal, use_mask)
+        p = _recompute_p(q_ref, k_ref, qp_ref, kp_ref, km_ref, qs_ref,
+                         ks_ref, lse_ref, scale, causal, use_mask,
+                         use_segs)
         do = do_ref[0]
+        # acc_dtype is the bwd accumulate knob (f32 default; the bf16
+        # study in docs/perf_attention.md measures the drift/speed
+        # trade): both the running scratch and the per-block matmul
+        # accumulate in it.
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=acc_dtype).astype(dv_acc.dtype)
         dp = jax.lax.dot_general(
             do, v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         # g_lse folds in here: d lse / d s = p, so the lse cotangent adds
         # p * g_lse — the term the ring's softmax-merge backward needs.
         ds = p * (dp - di_ref[0] + gl_ref[0])
-        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
+        dk_acc[:] = dk_acc[:] + (jax.lax.dot_general(
             ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
+            preferred_element_type=acc_dtype) * scale).astype(dk_acc.dtype)
 
-    _causal_when(causal, qp_ref, kp_ref, q_ref.shape[1], compute)
+    _skip_when(causal, use_segs, qp_ref, kp_ref, qs_ref, ks_ref,
+               q_ref.shape[1], compute)
 
     @pl.when(jq == nq - 1)
     def _():
@@ -197,9 +229,9 @@ def _bwd_dkv_kernel(qp_ref, kp_ref, km_ref, q_ref, k_ref, v_ref, do_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd_dq_kernel(qp_ref, kp_ref, km_ref, q_ref, k_ref, v_ref, do_ref,
-                   lse_ref, di_ref, gl_ref, dq_ref, dq_acc,
-                   *, scale, causal, use_mask, nk):
+def _bwd_dq_kernel(qp_ref, kp_ref, km_ref, qs_ref, ks_ref, q_ref, k_ref,
+                   v_ref, do_ref, lse_ref, di_ref, gl_ref, dq_ref, dq_acc,
+                   *, scale, causal, use_mask, use_segs, nk, acc_dtype):
     from jax.experimental import pallas as pl
 
     jk = pl.program_id(2)  # kv block index (innermost; Q block is parallel)
@@ -209,17 +241,19 @@ def _bwd_dq_kernel(qp_ref, kp_ref, km_ref, q_ref, k_ref, v_ref, do_ref,
         dq_acc[:] = jnp.zeros(dq_acc.shape, dq_acc.dtype)
 
     def compute():
-        p = _recompute_p(q_ref, k_ref, qp_ref, kp_ref, km_ref, lse_ref,
-                         scale, causal, use_mask)
+        p = _recompute_p(q_ref, k_ref, qp_ref, kp_ref, km_ref, qs_ref,
+                         ks_ref, lse_ref, scale, causal, use_mask,
+                         use_segs)
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - di_ref[0] + gl_ref[0])
-        dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
+        dq_acc[:] = dq_acc[:] + (jax.lax.dot_general(
             ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
+            preferred_element_type=acc_dtype) * scale).astype(dq_acc.dtype)
 
-    _causal_when(causal, qp_ref, kp_ref, q_ref.shape[1], compute)
+    _skip_when(causal, use_segs, qp_ref, kp_ref, qs_ref, ks_ref,
+               q_ref.shape[1], compute)
 
     @pl.when(jk == nk - 1)
     def _():
@@ -239,16 +273,31 @@ def _km_spec(pl, kb, use_mask, kv_axis):
     return pl.BlockSpec((1, kb), lambda i, j, k: (0, (j, k)[kv_axis - 1]))
 
 
-def _fwd_call(q3, k3, v3, km, qp, kp, scale, causal, use_mask, qb, kb,
-              interpret):
+def _seg_specs(pl, qb, kb, use_segs, q_axis, kv_axis):
+    """segment-id BlockSpecs: qs is a [bh, tq, 1] column array and ks a
+    [bh, 1, tk] row array, so in-kernel qs_ref[0]/ks_ref[0] broadcast to
+    [qb, kb] like the position arrays. When segments are off both are
+    shared [1, ...] zero arrays and every bh grid step maps to row 0
+    (the _km_spec trick)."""
+    bh = (lambda i: i) if use_segs else (lambda i: 0)
+    qspec = pl.BlockSpec((1, qb, 1),
+                         lambda i, j, k: (bh(i), (j, k)[q_axis - 1], 0))
+    kspec = pl.BlockSpec((1, 1, kb),
+                         lambda i, j, k: (bh(i), 0, (j, k)[kv_axis - 1]))
+    return qspec, kspec
+
+
+def _fwd_call(q3, k3, v3, km, qp, kp, qs, ks, scale, causal, use_mask,
+              use_segs, qb, kb, interpret):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     bh, tq, d = q3.shape
     tk = k3.shape[1]
     nq, nk = tq // qb, tk // kb
-    kern = functools.partial(_fwd_kernel, scale=scale,
-                             causal=causal, use_mask=use_mask, nk=nk)
+    kern = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                             use_mask=use_mask, use_segs=use_segs, nk=nk)
+    qs_spec, ks_spec = _seg_specs(pl, qb, kb, use_segs, q_axis=1, kv_axis=2)
     return pl.pallas_call(
         kern,
         grid=(bh, nq, nk),
@@ -256,6 +305,8 @@ def _fwd_call(q3, k3, v3, km, qp, kp, scale, causal, use_mask, qb, kb,
             pl.BlockSpec((qb, 1), lambda i, j, k: (j, 0)),
             pl.BlockSpec((1, kb), lambda i, j, k: (0, k)),
             _km_spec(pl, kb, use_mask, kv_axis=2),
+            qs_spec,
+            ks_spec,
             pl.BlockSpec((1, qb, d), lambda i, j, k: (i, j, 0)),
             pl.BlockSpec((1, kb, d), lambda i, j, k: (i, k, 0)),
             pl.BlockSpec((1, kb, d), lambda i, j, k: (i, k, 0)),
@@ -274,17 +325,19 @@ def _fwd_call(q3, k3, v3, km, qp, kp, scale, causal, use_mask, qb, kb,
             pltpu.VMEM((qb, d), jnp.float32),   # output accumulator
         ],
         interpret=interpret,
-    )(qp, kp, km, q3, k3, v3)
+    )(qp, kp, km, qs, ks, q3, k3, v3)
 
 
-def _bwd_calls(q3, k3, v3, km, qp, kp, o, lse, do, dlse,
-               scale, causal, use_mask, qb, kb, interpret):
+def _bwd_calls(q3, k3, v3, km, qp, kp, qs, ks, o, lse, do, dlse,
+               scale, causal, use_mask, use_segs, qb, kb, interpret,
+               bwd_acc_dtype):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     bh, tq, d = q3.shape
     tk = k3.shape[1]
     nq, nk = tq // qb, tk // kb
+    acc_dt = jnp.dtype(bwd_acc_dtype)
     di = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1,
                  keepdims=True)               # [bh, tq, 1]
     gl = dlse.astype(jnp.float32)             # lse cotangent [bh, tq, 1]
@@ -292,7 +345,9 @@ def _bwd_calls(q3, k3, v3, km, qp, kp, o, lse, do, dlse,
     # dk/dv: grid (bh, nk, nq) — KV block parallel, Q sweep innermost.
     qrow = lambda i, j, k: (i, k, 0)          # q-indexed rows by inner dim
     dkv_kern = functools.partial(_bwd_dkv_kernel, scale=scale,
-                                 causal=causal, use_mask=use_mask, nq=nq)
+                                 causal=causal, use_mask=use_mask,
+                                 use_segs=use_segs, nq=nq, acc_dtype=acc_dt)
+    qs_dkv, ks_dkv = _seg_specs(pl, qb, kb, use_segs, q_axis=2, kv_axis=1)
     dk, dv = pl.pallas_call(
         dkv_kern,
         grid=(bh, nk, nq),
@@ -300,6 +355,8 @@ def _bwd_calls(q3, k3, v3, km, qp, kp, o, lse, do, dlse,
             pl.BlockSpec((qb, 1), lambda i, j, k: (k, 0)),
             pl.BlockSpec((1, kb), lambda i, j, k: (0, j)),
             _km_spec(pl, kb, use_mask, kv_axis=1),
+            qs_dkv,
+            ks_dkv,
             pl.BlockSpec((1, qb, d), qrow),                       # q
             pl.BlockSpec((1, kb, d), lambda i, j, k: (i, j, 0)),  # k
             pl.BlockSpec((1, kb, d), lambda i, j, k: (i, j, 0)),  # v
@@ -317,16 +374,18 @@ def _bwd_calls(q3, k3, v3, km, qp, kp, o, lse, do, dlse,
             jax.ShapeDtypeStruct((bh, tk, d), v3.dtype),
         ],
         scratch_shapes=[
-            pltpu.VMEM((kb, d), jnp.float32),
-            pltpu.VMEM((kb, d), jnp.float32),
+            pltpu.VMEM((kb, d), acc_dt),
+            pltpu.VMEM((kb, d), acc_dt),
         ],
         interpret=interpret,
-    )(qp, kp, km, q3, k3, v3, do, lse, di, gl)
+    )(qp, kp, km, qs, ks, q3, k3, v3, do, lse, di, gl)
 
     # dq: grid (bh, nq, nk) — Q block parallel, KV sweep innermost.
     qblk = lambda i, j, k: (i, j, 0)
     dq_kern = functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                                use_mask=use_mask, nk=nk)
+                                use_mask=use_mask, use_segs=use_segs,
+                                nk=nk, acc_dtype=acc_dt)
+    qs_dq, ks_dq = _seg_specs(pl, qb, kb, use_segs, q_axis=1, kv_axis=2)
     dq = pl.pallas_call(
         dq_kern,
         grid=(bh, nq, nk),
@@ -334,6 +393,8 @@ def _bwd_calls(q3, k3, v3, km, qp, kp, o, lse, do, dlse,
             pl.BlockSpec((qb, 1), lambda i, j, k: (j, 0)),
             pl.BlockSpec((1, kb), lambda i, j, k: (0, k)),
             _km_spec(pl, kb, use_mask, kv_axis=2),
+            qs_dq,
+            ks_dq,
             pl.BlockSpec((1, qb, d), qblk),                       # q
             pl.BlockSpec((1, kb, d), lambda i, j, k: (i, k, 0)),  # k
             pl.BlockSpec((1, kb, d), lambda i, j, k: (i, k, 0)),  # v
@@ -344,9 +405,9 @@ def _bwd_calls(q3, k3, v3, km, qp, kp, o, lse, do, dlse,
         ],
         out_specs=pl.BlockSpec((1, qb, d), qblk),
         out_shape=jax.ShapeDtypeStruct((bh, tq, d), q3.dtype),
-        scratch_shapes=[pltpu.VMEM((qb, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((qb, d), acc_dt)],
         interpret=interpret,
-    )(qp, kp, km, q3, k3, v3, do, lse, di, gl)
+    )(qp, kp, km, qs, ks, q3, k3, v3, do, lse, di, gl)
     return dq, dk, dv
 
 
@@ -354,29 +415,35 @@ def _bwd_calls(q3, k3, v3, km, qp, kp, o, lse, do, dlse,
 # custom_vjp core over [bh, t, d].
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11))
-def _flash(q3, k3, v3, km, qp, kp, scale, causal, use_mask, qb, kb,
-           interpret):
-    return _fwd_call(q3, k3, v3, km, qp, kp, scale, causal, use_mask, qb,
-                     kb, interpret)
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(8, 9, 10, 11, 12, 13, 14, 15))
+def _flash(q3, k3, v3, km, qp, kp, qs, ks, scale, causal, use_mask,
+           use_segs, qb, kb, interpret, bwd_acc_dtype):
+    return _fwd_call(q3, k3, v3, km, qp, kp, qs, ks, scale, causal,
+                     use_mask, use_segs, qb, kb, interpret)
 
 
-def _flash_fwd(q3, k3, v3, km, qp, kp, scale, causal, use_mask, qb, kb,
-               interpret):
-    o, lse = _fwd_call(q3, k3, v3, km, qp, kp, scale, causal, use_mask,
-                       qb, kb, interpret)
-    return (o, lse), (q3, k3, v3, km, qp, kp, o, lse)
+def _flash_fwd(q3, k3, v3, km, qp, kp, qs, ks, scale, causal, use_mask,
+               use_segs, qb, kb, interpret, bwd_acc_dtype):
+    o, lse = _fwd_call(q3, k3, v3, km, qp, kp, qs, ks, scale, causal,
+                       use_mask, use_segs, qb, kb, interpret)
+    return (o, lse), (q3, k3, v3, km, qp, kp, qs, ks, o, lse)
 
 
-def _flash_bwd(scale, causal, use_mask, qb, kb, interpret, res, cts):
-    q3, k3, v3, km, qp, kp, o, lse = res
+def _flash_bwd(scale, causal, use_mask, use_segs, qb, kb, interpret,
+               bwd_acc_dtype, res, cts):
+    q3, k3, v3, km, qp, kp, qs, ks, o, lse = res
     do, dlse = cts
-    dq, dk, dv = _bwd_calls(q3, k3, v3, km, qp, kp, o, lse, do, dlse,
-                            scale, causal, use_mask, qb, kb, interpret)
-    # Mask and int32 positions are non-differentiable: zero / float0.
+    dq, dk, dv = _bwd_calls(q3, k3, v3, km, qp, kp, qs, ks, o, lse, do,
+                            dlse, scale, causal, use_mask, use_segs, qb,
+                            kb, interpret, bwd_acc_dtype)
+    # Mask, int32 positions and int32 segment ids are non-differentiable:
+    # zero / float0.
     return (dq, dk, dv, jnp.zeros_like(km),
             np.zeros(qp.shape, jax.dtypes.float0),
-            np.zeros(kp.shape, jax.dtypes.float0))
+            np.zeros(kp.shape, jax.dtypes.float0),
+            np.zeros(qs.shape, jax.dtypes.float0),
+            np.zeros(ks.shape, jax.dtypes.float0))
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -387,9 +454,11 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 # ---------------------------------------------------------------------------
 
 def flash_attention(q, k, v, *, causal: bool = False, key_mask=None,
+                    segment_ids=None, kv_segment_ids=None,
                     q_pos=None, kv_pos=None, q_block: int = 0,
                     kv_block: int = 0, interpret: bool = False,
-                    with_lse: bool = False):
+                    with_lse: bool = False,
+                    bwd_acc_dtype: str = "float32"):
     """Fused flash attention over [batch, time, heads, head_dim].
 
     Matches dense_attention semantics (scaling, NEG masking, zero output
@@ -399,6 +468,22 @@ def flash_attention(q, k, v, *, causal: bool = False, key_mask=None,
     global offsets here. `with_lse=True` additionally returns the
     per-row log-sum-exp as [batch, time, heads] f32 (NEG sentinel for
     fully-masked rows); its cotangent is supported.
+
+    `segment_ids` ([batch, t_q] int, or 1-D [t_q] shared across the
+    batch) packs multiple sequences into one row: attention is masked
+    wherever q and kv segment ids differ, and whole cross-segment tiles
+    are skipped on the block-skip path. `kv_segment_ids` defaults to
+    `segment_ids` (self-attention); pass it explicitly for
+    cross-attention geometries. Combine with `key_mask`/`causal` freely
+    — masks compose by conjunction. Causal masking inside a packed row
+    stays exact under the default global arange positions: the segment
+    equality already removes cross-segment pairs, and within a segment
+    global and local position orders agree.
+
+    `bwd_acc_dtype` selects the accumulate dtype of the backward
+    kernels' scratch and matmuls ("float32" default; "bfloat16" trades
+    grad precision for bandwidth — drift numbers in
+    docs/perf_attention.md).
     """
     b, tq, hh, d = q.shape
     tk = k.shape[1]
@@ -424,9 +509,27 @@ def flash_attention(q, k, v, *, causal: bool = False, key_mask=None,
     kp = (jnp.arange(tk, dtype=jnp.int32) if kv_pos is None
           else kv_pos.astype(jnp.int32)).reshape(1, tk)
 
+    use_segs = segment_ids is not None
+    if kv_segment_ids is not None and not use_segs:
+        raise ValueError("kv_segment_ids requires segment_ids")
+    if use_segs:
+        def seg_rows(seg, t):  # -> [b*h, t] int32, broadcast over heads
+            seg = jnp.asarray(seg, jnp.int32)
+            if seg.ndim == 1:
+                seg = jnp.broadcast_to(seg[None, :], (b, t))
+            return jnp.broadcast_to(seg[:, None, :],
+                                    (b, hh, t)).reshape(b * hh, t)
+        seg_k = segment_ids if kv_segment_ids is None else kv_segment_ids
+        qs = seg_rows(segment_ids, tq).reshape(b * hh, tq, 1)
+        ks = seg_rows(seg_k, tk).reshape(b * hh, 1, tk)
+    else:
+        qs = jnp.zeros((1, tq, 1), jnp.int32)
+        ks = jnp.zeros((1, 1, tk), jnp.int32)
+
     # Softmax scale uses the TRUE head_dim, not the lane-padded one.
-    o3, lse3 = _flash(q3, k3, v3, km, qp, kp, 1.0 / math.sqrt(d), causal,
-                      use_mask, qb, kb, interpret)
+    o3, lse3 = _flash(q3, k3, v3, km, qp, kp, qs, ks,
+                      1.0 / math.sqrt(d), causal, use_mask, use_segs,
+                      qb, kb, interpret, str(bwd_acc_dtype))
     o = o3[:, :, :d].reshape(b, hh, tq, d).transpose(0, 2, 1, 3)
     if not with_lse:
         return o

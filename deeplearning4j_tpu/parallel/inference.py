@@ -97,7 +97,8 @@ class ParallelInference:
 
     def __init__(self, model, *, inference_mode: InferenceMode = InferenceMode.BATCHED,
                  batch_limit: int = 32, queue_limit: int = 64,
-                 batch_timeout_ms: float = 2.0, check_finite: bool = False):
+                 batch_timeout_ms: float = 2.0, check_finite: bool = False,
+                 packed_admission: bool = False, pack_bucket: int = 0):
         if not getattr(model, "_initialized", False):
             raise RuntimeError("Model must be init()ed (or restored) before "
                                "serving")
@@ -105,6 +106,26 @@ class ParallelInference:
         self.inference_mode = inference_mode
         self.batch_limit = int(batch_limit)
         self.batch_timeout_ms = float(batch_timeout_ms)
+        # Packed admission (docs/serving.md §packed): coalesce short
+        # single-row sequence requests into ONE [1, pack_bucket] row
+        # separated by segment ids, instead of one batch row each — the
+        # serving counterpart of PackToBucketIterator. Requires a model
+        # whose attention layers run packed_segments=True (outputs are
+        # then bitwise-identical to solo forwards). Ineligible requests
+        # (multi-row, non-sequence, too long) fall back to the ordinary
+        # row-coalescing path and are counted.
+        self.packed_admission = bool(packed_admission)
+        self.pack_bucket = int(pack_bucket)
+        if self.packed_admission:
+            if inference_mode != InferenceMode.BATCHED:
+                raise ValueError(
+                    "packed_admission requires InferenceMode.BATCHED")
+            if self.pack_bucket < 1:
+                raise ValueError(
+                    "packed_admission needs pack_bucket >= 1 (the token "
+                    "capacity of the packed row)")
+        self.total_packed_requests = 0
+        self.total_pack_fallbacks = 0
         # check_finite: scan each forward's output for NaN/Inf and fail
         # the batch with NonFiniteOutputError (the breaker's instant
         # trip). Off by default — the host-side isfinite scan is cheap
@@ -141,6 +162,12 @@ class ParallelInference:
                 daemon=True)
             self._worker.start()
 
+    def _pack_eligible(self, x: np.ndarray) -> bool:
+        """A request can ride a packed row iff it is a single sequence:
+        one batch row of [1, t, features] with 1 <= t <= pack_bucket."""
+        return (x.ndim == 3 and x.shape[0] == 1
+                and 0 < x.shape[1] <= self.pack_bucket)
+
     # ---------------------------------------------------------------- builder
     @staticmethod
     def builder(model) -> "ParallelInferenceBuilder":
@@ -166,6 +193,15 @@ class ParallelInference:
             if b not in self.warmed_buckets:
                 self.warmed_buckets.append(b)
             b <<= 1
+        if self.packed_admission:
+            # The packed forward passes a features_mask (the segment
+            # ids), which is a DIFFERENT jit pytree signature than the
+            # maskless sweep above — warm it too, or the first packed
+            # batch pays the compile warmup exists to prevent.
+            x_s = self.model._feature_struct(1, self.pack_bucket)
+            self.model.output(np.zeros(x_s.shape, x_s.dtype),
+                              features_mask=np.zeros(
+                                  (1, self.pack_bucket), np.float32))
         return self
 
     # ------------------------------------------------------------ admission
@@ -289,7 +325,10 @@ class ParallelInference:
     # -------------------------------------------------------------- collector
     def _collector_loop(self):
         try:
-            self._collect()
+            if self.packed_admission:
+                self._collect_packed()
+            else:
+                self._collect()
         except BaseException as e:
             # Collector must never die silently: mark the server down
             # (under the enqueue lock so no request can slip in after the
@@ -447,6 +486,156 @@ class ParallelInference:
             for r in batch:
                 self._run_batch([r])
 
+    # ---------------------------------------------------------------- packed
+    def _collect_packed(self):
+        """Packed-admission collector: capacity is TOKENS in one
+        [1, pack_bucket] row, not batch rows. Eligible requests coalesce
+        by first-come token fit (carry on overflow, same as the bucket
+        overshoot carry of _collect); an ineligible request flushes the
+        packed batch and runs through the ordinary row path alone —
+        deadline, breaker, and shutdown semantics are shared with
+        _collect."""
+        cap = self.pack_bucket
+        carry: Optional[_Request] = None
+        while True:
+            if carry is not None:
+                first, carry = carry, None
+            else:
+                try:
+                    first = self._queue.get(timeout=0.1)
+                except queue.Empty:
+                    if self._shutdown:
+                        return
+                    continue
+            if first is None:  # shutdown sentinel: serve stragglers, exit
+                self._drain_and_exit_packed()
+                return
+            if not self._pack_eligible(first.x):
+                self._note_pack_fallback(1)
+                self._run_batch([first])
+                continue
+            batch = [first]
+            toks = first.x.shape[1]
+            if toks < cap:
+                time.sleep(self.batch_timeout_ms / 1000.0)
+            saw_sentinel = False
+            while toks < cap:
+                try:
+                    nxt = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    saw_sentinel = True
+                    break
+                if not self._pack_eligible(nxt.x) or \
+                        toks + nxt.x.shape[1] > cap:
+                    carry = nxt
+                    break
+                batch.append(nxt)
+                toks += nxt.x.shape[1]
+            self._run_packed(batch)
+            if saw_sentinel:
+                self._drain_and_exit_packed(carry)
+                return
+
+    def _drain_and_exit_packed(self, carry: Optional[_Request] = None):
+        """Shutdown flush for packed mode: serve queued stragglers in
+        token-capacity packed rows; ineligible ones run alone through
+        the row path."""
+        leftovers = [] if carry is None else [carry]
+        while True:
+            try:
+                r = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if r is not None:
+                leftovers.append(r)
+        batch: List[_Request] = []
+        toks = 0
+        for r in leftovers:
+            if not self._pack_eligible(r.x):
+                self._note_pack_fallback(1)
+                self._run_batch([r])
+                continue
+            if batch and toks + r.x.shape[1] > self.pack_bucket:
+                self._run_packed(batch)
+                batch, toks = [], 0
+            batch.append(r)
+            toks += r.x.shape[1]
+        if batch:
+            self._run_packed(batch)
+
+    def _note_pack_fallback(self, n: int) -> None:
+        self.total_pack_fallbacks += n
+        from ..data.padding import record_packing
+        record_packing("serve", fallbacks=n)
+
+    def _run_packed(self, batch: List[_Request]):
+        now = time.monotonic()
+        live = []
+        for r in batch:  # SLO late-shed, same contract as _run_batch
+            if r.expired(now):
+                self._shed(r, "expired")
+                r.error = DeadlineExceededError(
+                    "deadline passed while queued")
+                r.event.set()
+            else:
+                live.append(r)
+        batch = live
+        if not batch:
+            return
+        try:
+            # Chaos seam: an armed "serve.pack" plan fails the assembly
+            # (and, below, the unpack) of a packed row deterministically.
+            faults.fire("serve.pack")
+            feat = batch[0].x.shape[2]
+            xs = np.zeros((1, self.pack_bucket, feat), batch[0].x.dtype)
+            segmask = np.zeros((1, self.pack_bucket), np.float32)
+            ofs = 0
+            for s, r in enumerate(batch, start=1):
+                t_i = r.x.shape[1]
+                xs[0, ofs:ofs + t_i] = r.x[0]
+                segmask[0, ofs:ofs + t_i] = s
+                ofs += t_i
+            t0 = time.perf_counter()
+            with self._lock:
+                faults.fire("serve.forward")
+                out = self.model.output(xs, features_mask=segmask)
+                dur = time.perf_counter() - t0
+                self._ewma_batch_s = dur if self._ewma_batch_s <= 0.0 \
+                    else 0.8 * self._ewma_batch_s + 0.2 * dur
+            self._require_finite(out)
+            self.executed_batch_sizes.append(len(batch))
+            self.total_forwards += 1
+            self.total_packed_requests += len(batch)
+            from ..data.padding import record_packing
+            record_packing("serve", items=len(batch), real_tokens=ofs,
+                           padded_tokens=self.pack_bucket)
+            cb = self.on_batch
+            if cb is not None:
+                try:
+                    cb(batch, len(batch), self.pack_bucket, dur)
+                except Exception:
+                    pass  # a broken hook must never take the server down
+            faults.fire("serve.pack")
+            out = np.asarray(out)
+            ofs = 0
+            for r in batch:
+                t_i = r.x.shape[1]
+                r.result = out[:, ofs:ofs + t_i]
+                ofs += t_i
+                r.event.set()
+        except BaseException as e:
+            err = self._batch_failure(e, len(batch))
+            if len(batch) == 1:
+                batch[0].error = err
+                batch[0].event.set()
+                return
+            # Packed-batch isolation mirrors _run_batch: retry each
+            # request in its own packed row so only the offender fails.
+            for r in batch:
+                self._run_packed([r])
+
     # --------------------------------------------------------------- shutdown
     def _fail_pending(self, exc: BaseException) -> None:
         """Fail every request still queued so no caller is stranded
@@ -502,6 +691,8 @@ class ParallelInferenceBuilder:
         self._queue_limit = 64
         self._timeout_ms = 2.0
         self._check_finite = False
+        self._packed_admission = False
+        self._pack_bucket = 0
 
     def inference_mode(self, mode: InferenceMode):
         self._mode = mode
@@ -523,9 +714,19 @@ class ParallelInferenceBuilder:
         self._check_finite = bool(enabled)
         return self
 
+    def packed_admission(self, bucket: int):
+        """Coalesce short sequence requests into one [1, bucket] packed
+        row (segment ids through the feature mask). The served model's
+        attention layers must run packed_segments=True."""
+        self._packed_admission = True
+        self._pack_bucket = int(bucket)
+        return self
+
     def build(self) -> ParallelInference:
         return ParallelInference(
             self._model, inference_mode=self._mode,
             batch_limit=self._batch_limit, queue_limit=self._queue_limit,
             batch_timeout_ms=self._timeout_ms,
-            check_finite=self._check_finite)
+            check_finite=self._check_finite,
+            packed_admission=self._packed_admission,
+            pack_bucket=self._pack_bucket)

@@ -171,7 +171,9 @@ class ModelPool:
             breaker_threshold: int = 5,
             breaker_reset_s: float = 30.0,
             golden_batch=None,
-            canary_max_drift: Optional[float] = None) -> ModelEntry:
+            canary_max_drift: Optional[float] = None,
+            packed_admission: bool = False,
+            pack_bucket: int = 0) -> ModelEntry:
         """Register an init()ed model under `name` behind a fresh
         continuous-batching engine. `checkpoints` (a CheckpointManager
         or a directory path) enables hot-swap for this entry.
@@ -183,14 +185,19 @@ class ModelPool:
         guards this entry's /predict path; `golden_batch` seeds the
         swap canary input (otherwise the first served request's rows
         are retained); `canary_max_drift` bounds output drift a swap
-        may introduce on the golden batch (None = finiteness only)."""
+        may introduce on the golden batch (None = finiteness only);
+        `packed_admission`/`pack_bucket` coalesce short sequence
+        requests into one segment-masked [1, pack_bucket] row (the
+        model's attention layers must run packed_segments=True —
+        docs/serving.md §packed)."""
         if isinstance(checkpoints, (str, os.PathLike)):
             from ..optimize.resilience import CheckpointManager
             checkpoints = CheckpointManager(checkpoints)
         engine = ParallelInference(
             model, inference_mode=inference_mode, batch_limit=batch_limit,
             queue_limit=queue_limit, batch_timeout_ms=batch_timeout_ms,
-            check_finite=check_finite)
+            check_finite=check_finite, packed_admission=packed_admission,
+            pack_bucket=pack_bucket)
         if breaker is None:
             breaker = CircuitBreaker(name,
                                      failure_threshold=breaker_threshold,

@@ -54,6 +54,9 @@ Points used by the serving stack (docs/serving.md):
                        each SEQUENTIAL-mode forward)
     serve.decode       the checkpoint decode/stage step of a hot-swap,
                        before any live state is mutated
+    serve.pack         packed-admission assembly/unpack of a segment-
+                       masked row (fires twice per packed forward:
+                       before the pack and before the unpack)
     swap.warm          each per-bucket warm forward inside the
                        pause-assign-warm swap window (fires the rollback
                        path when armed)

@@ -28,6 +28,12 @@ JAX_PLATFORMS=cpu python tests/smoke_attention.py
 # gates before the suite like the attention smoke.
 JAX_PLATFORMS=cpu python tests/smoke_pooling.py
 
+# Packed-varlen smoke (docs/perf_data_pipeline.md §PackToBucket, ISSUE
+# 13): segment-masked flash kernel parity in interpret mode, the
+# first-fit packing arithmetic, packed-score == unpacked-score
+# exactness on a tiny net, and the packing metric families. Seconds.
+JAX_PLATFORMS=cpu python tests/smoke_packing.py
+
 python -m pytest tests/ -q "$@"
 
 # Observability smoke (docs/observability.md): a real 2-epoch fit with

@@ -1,0 +1,176 @@
+"""Packed serving admission (ISSUE 13): short sequence requests
+coalesced into one segment-masked [1, pack_bucket] row by
+ParallelInference(packed_admission=True).
+
+Validation/eligibility logic is tier-1 (no jit); the end-to-end rows —
+bitwise round-trip under concurrent load with zero steady-state
+compiles, the serve.pack chaos seam, and the shutdown drain — build and
+warm a real packed_segments attention model, so they ride the `slow`
+marker (tier-1 budget; ROADMAP maintenance note).
+"""
+import threading
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.parallel.inference import (BatchExecutionError,
+                                                   InferenceMode,
+                                                   ParallelInference)
+from deeplearning4j_tpu.utils import faults
+
+FEAT = 8
+BUCKET = 16
+
+
+def make_packed_net(feat=FEAT):
+    from deeplearning4j_tpu import (Adam, InputType, MultiLayerNetwork,
+                                    NeuralNetConfiguration, RnnOutputLayer)
+    from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer
+    conf = (NeuralNetConfiguration.builder().seed(5).updater(Adam(1e-3))
+            .list()
+            .layer(SelfAttentionLayer(n_out=8, n_heads=2, causal=True,
+                                      packed_segments=True))
+            .layer(RnnOutputLayer(n_out=3, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(InputType.recurrent(feat)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+class _StubModel:
+    """Initialized-model stand-in for no-jit validation tests."""
+    _initialized = True
+
+    def output(self, x, **kw):  # pragma: no cover - never dispatched
+        return np.asarray(x)
+
+
+class TestPackedAdmissionValidation:
+    def test_requires_batched_mode(self):
+        with pytest.raises(ValueError, match="BATCHED"):
+            ParallelInference(_StubModel(),
+                              inference_mode=InferenceMode.SEQUENTIAL,
+                              packed_admission=True, pack_bucket=8)
+
+    def test_requires_positive_bucket(self):
+        with pytest.raises(ValueError, match="pack_bucket"):
+            ParallelInference(_StubModel(), packed_admission=True,
+                              pack_bucket=0)
+
+    def test_eligibility(self):
+        pi = ParallelInference(_StubModel(), packed_admission=True,
+                               pack_bucket=8)
+        try:
+            ok = np.zeros((1, 5, 3), np.float32)
+            assert pi._pack_eligible(ok)
+            assert not pi._pack_eligible(np.zeros((2, 5, 3)))  # multi-row
+            assert not pi._pack_eligible(np.zeros((1, 9, 3)))  # too long
+            assert not pi._pack_eligible(np.zeros((1, 0, 3)))  # empty
+            assert not pi._pack_eligible(np.zeros((1, 5)))     # rank 2
+        finally:
+            pi.shutdown()
+
+    def test_builder_knobs(self):
+        pi = (ParallelInference.builder(_StubModel())
+              .packed_admission(8).build())
+        try:
+            assert pi.packed_admission and pi.pack_bucket == 8
+        finally:
+            pi.shutdown()
+
+
+@pytest.mark.slow
+class TestPackedServingEndToEnd:
+    def _engine(self, net, **kw):
+        kw.setdefault("batch_limit", 8)
+        kw.setdefault("batch_timeout_ms", 10.0)
+        pi = ParallelInference(net, packed_admission=True,
+                               pack_bucket=BUCKET, **kw)
+        pi.warmup(max_bucket=1, time_steps=BUCKET)
+        return pi
+
+    def test_concurrent_roundtrip_bitwise_zero_compiles(self):
+        from deeplearning4j_tpu.optimize.telemetry import CompilationTracker
+        net = make_packed_net()
+        rng = np.random.default_rng(0)
+        reqs = [rng.standard_normal((1, t, FEAT)).astype(np.float32)
+                for t in (5, 7, 3, 6, 4, 2)]
+        solo = [np.asarray(net.output(x)) for x in reqs]
+        pi = self._engine(net)
+        try:
+            results = [None] * len(reqs)
+            errors = [None] * len(reqs)
+            with CompilationTracker() as trk:
+                def client(i):
+                    try:
+                        results[i] = np.asarray(pi.output(reqs[i]))
+                    except BaseException as e:
+                        errors[i] = e
+                ts = [threading.Thread(target=client, args=(i,))
+                      for i in range(len(reqs))]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+                assert trk.count == 0, \
+                    f"packed steady state compiled {trk.count}x"
+            for i, (r, s) in enumerate(zip(results, solo)):
+                assert errors[i] is None, f"req {i}: {errors[i]}"
+                assert r.shape == s.shape
+                assert np.all(r == s), f"req {i} not bitwise identical"
+            assert pi.total_packed_requests == len(reqs)
+            assert pi.total_forwards < len(reqs), "nothing coalesced"
+        finally:
+            pi.shutdown()
+
+    def test_ineligible_falls_back_to_row_path(self):
+        net = make_packed_net()
+        pi = self._engine(net)
+        try:
+            x2 = np.random.default_rng(1).standard_normal(
+                (2, 6, FEAT)).astype(np.float32)
+            want = np.asarray(net.output(x2))
+            got = np.asarray(pi.output(x2))
+            np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+            assert pi.total_pack_fallbacks == 1
+            assert pi.total_packed_requests == 0
+        finally:
+            pi.shutdown()
+
+    def test_serve_pack_fault_fails_typed_and_server_survives(self):
+        net = make_packed_net()
+        pi = self._engine(net)
+        x = np.random.default_rng(2).standard_normal(
+            (1, 5, FEAT)).astype(np.float32)
+        try:
+            with faults.injected("serve.pack", "fail:1/1"):
+                with pytest.raises(BatchExecutionError):
+                    pi.output(x)
+            # the collector survived the armed fault: traffic resumes
+            out = np.asarray(pi.output(x))
+            assert np.all(out == np.asarray(net.output(x)))
+            assert pi.total_batch_failures >= 1
+        finally:
+            pi.shutdown()
+
+    def test_shutdown_drains_queued_packed_requests(self):
+        net = make_packed_net()
+        # a long linger so requests are still queued when shutdown lands
+        pi = self._engine(net, batch_timeout_ms=300.0)
+        rng = np.random.default_rng(3)
+        reqs = [rng.standard_normal((1, 4, FEAT)).astype(np.float32)
+                for _ in range(4)]
+        solo = [np.asarray(net.output(x)) for x in reqs]
+        results = [None] * len(reqs)
+
+        def client(i):
+            results[i] = np.asarray(pi.output(reqs[i]))
+
+        ts = [threading.Thread(target=client, args=(i,))
+              for i in range(len(reqs))]
+        for t in ts:
+            t.start()
+        pi.shutdown()
+        for t in ts:
+            t.join()
+        for r, s in zip(results, solo):
+            assert r is not None and np.all(r == s)

@@ -1,0 +1,224 @@
+"""PackToBucket training pipeline (ISSUE 13): first-fit packing
+primitives (data/padding.py), the PackToBucketIterator, and the packing
+observability families. The jit-heavy loss-exactness proof (packed
+score == unpacked ragged score, bit-for-bit through the rank-2
+zero-weight contract) rides the `slow` marker; the packing arithmetic
+itself is pure numpy and stays tier-1.
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import (ExistingDataSetIterator,
+                                               PackToBucketIterator)
+from deeplearning4j_tpu.data.padding import (first_fit_pack, pack_sequences,
+                                             record_packing,
+                                             register_packing_metrics)
+
+
+def _ragged_batch(lengths, t, f=4, classes=3, seed=0, lmask=None):
+    """[n, t, f] batch with contiguous-from-start masks of the given
+    lengths (zeros beyond each length, like a real padded loader)."""
+    rng = np.random.default_rng(seed)
+    n = len(lengths)
+    feats = rng.standard_normal((n, t, f)).astype(np.float32)
+    labels = np.eye(classes, dtype=np.float32)[
+        rng.integers(0, classes, (n, t))]
+    mask = (np.arange(t)[None, :] < np.asarray(lengths)[:, None]
+            ).astype(np.float32)
+    feats *= mask[..., None]
+    labels *= mask[..., None]
+    lm = mask if lmask is None else np.asarray(lmask, np.float32) * mask
+    return DataSet(feats, labels, mask, lm)
+
+
+class TestFirstFitPack:
+    def test_first_fit_in_arrival_order(self):
+        # 5 into [0]; 7 opens [1]; 3 fits the FIRST bin with room ([0]);
+        # 6 fits [1]'s remaining 1? no -> opens [2]; 2 fits [0].
+        bins = first_fit_pack([5, 7, 3, 6, 2], 10)
+        assert bins == [[0, 2, 4], [1], [3]]
+
+    def test_exact_fill(self):
+        assert first_fit_pack([4, 4, 4, 4], 8) == [[0, 1], [2, 3]]
+
+    def test_oversize_and_nonpositive_raise(self):
+        with pytest.raises(ValueError):
+            first_fit_pack([9], 8)
+        with pytest.raises(ValueError):
+            first_fit_pack([0], 8)
+        with pytest.raises(ValueError):
+            first_fit_pack([4], 0)
+
+    def test_deterministic(self):
+        lens = list(np.random.default_rng(1).integers(1, 17, 50))
+        assert first_fit_pack(lens, 16) == first_fit_pack(lens, 16)
+
+
+class TestPackSequences:
+    def test_layout_segments_positions_masks(self):
+        ds = _ragged_batch([3, 5, 2], t=6)
+        f, l, seg, lm, pos = pack_sequences(
+            ds.features, ds.labels, [3, 5, 2], 8)
+        # first-fit: 3 + 5 fill row 0 exactly (ids 1, 2); 2 opens row 1
+        assert f.shape == (2, 8, 4) and seg.shape == (2, 8)
+        np.testing.assert_array_equal(
+            seg[0], [1, 1, 1, 2, 2, 2, 2, 2])
+        np.testing.assert_array_equal(
+            seg[1], [1, 1, 0, 0, 0, 0, 0, 0])
+        np.testing.assert_array_equal(
+            pos[0], [0, 1, 2, 0, 1, 2, 3, 4])  # positions reset per seg
+        np.testing.assert_array_equal(lm[0], seg[0] > 0)
+        # feature tokens land intact at their offsets
+        np.testing.assert_array_equal(f[0, :3], ds.features[0, :3])
+        np.testing.assert_array_equal(f[0, 3:8], ds.features[1, :5])
+        np.testing.assert_array_equal(f[1, :2], ds.features[2, :2])
+        assert np.all(f[1, 2:] == 0.0)
+
+    def test_user_labels_mask_spliced(self):
+        lens = [3, 2]
+        ds = _ragged_batch(lens, t=4)
+        user = np.array([[0.5, 0.5, 0.5, 0.0],
+                         [2.0, 2.0, 0.0, 0.0]], np.float32)
+        _, _, seg, lm, _ = pack_sequences(ds.features, ds.labels, lens, 8,
+                                          labels_mask=user)
+        np.testing.assert_array_equal(
+            lm[0], [0.5, 0.5, 0.5, 2.0, 2.0, 0.0, 0.0, 0.0])
+
+    def test_rows_pad_and_overflow(self):
+        ds = _ragged_batch([4, 4], t=4)
+        f, _, seg, lm, _ = pack_sequences(ds.features, ds.labels, [4, 4],
+                                          4, rows=4)
+        assert f.shape[0] == 4
+        assert np.all(seg[2:] == 0) and np.all(lm[2:] == 0)
+        with pytest.raises(ValueError):
+            pack_sequences(ds.features, ds.labels, [4, 4], 4, rows=1)
+
+
+class TestPackToBucketIterator:
+    def test_one_canonical_shape_per_epoch(self):
+        batches = [_ragged_batch([5, 7, 3], t=8, seed=1),
+                   _ragged_batch([2, 6, 6], t=8, seed=2),
+                   _ragged_batch([8, 1, 1], t=8, seed=3)]
+        it = PackToBucketIterator(ExistingDataSetIterator(batches))
+        shapes = {np.asarray(ds.features).shape for ds in it}
+        assert len(shapes) == 1, f"ragged emitted shapes: {shapes}"
+        (shape,) = shapes
+        assert shape[1] == 8  # pow2 bucket of the first batch's max (7)
+
+    def test_segment_ids_and_loss_mask_count_real_tokens(self):
+        lengths = [5, 7, 3, 6, 2]
+        it = PackToBucketIterator(
+            ExistingDataSetIterator([_ragged_batch(lengths, t=8)]),
+            bucket_len=8)
+        total_real = 0
+        for ds in it:
+            fm = np.asarray(ds.features_mask)
+            lm = np.asarray(ds.labels_mask)
+            np.testing.assert_array_equal(lm > 0, fm > 0)
+            total_real += int((fm > 0).sum())
+            assert hasattr(ds, "packed_positions")
+        assert total_real == sum(lengths)
+
+    def test_second_batch_reuses_first_geometry(self):
+        batches = [_ragged_batch([4, 4], t=4, seed=1),
+                   _ragged_batch([4] * 6, t=4, seed=2)]
+        it = PackToBucketIterator(ExistingDataSetIterator(batches),
+                                  bucket_len=8)
+        out = list(it)
+        # batch 1 -> 1 packed row-pair; batch 2 needs 3 bins -> split
+        # into ceil(3/1)=3 emissions of the SAME (rows, bucket) shape
+        assert all(np.asarray(d.features).shape
+                   == np.asarray(out[0].features).shape for d in out)
+
+    def test_oversize_sequence_raises(self):
+        it = PackToBucketIterator(
+            ExistingDataSetIterator([_ragged_batch([6], t=6)]),
+            bucket_len=4)
+        with pytest.raises(ValueError):
+            next(iter(it))
+
+    def test_non_contiguous_mask_raises(self):
+        ds = _ragged_batch([4], t=4)
+        holey = np.asarray(ds.features_mask).copy()
+        holey[0, 1] = 0.0  # mid-sequence hole
+        bad = DataSet(ds.features, ds.labels, holey, ds.labels_mask)
+        it = PackToBucketIterator(ExistingDataSetIterator([bad]))
+        with pytest.raises(ValueError):
+            next(iter(it))
+
+    def test_reset_replays(self):
+        it = PackToBucketIterator(
+            ExistingDataSetIterator([_ragged_batch([3, 3], t=4)]),
+            bucket_len=8)
+        a = [np.asarray(d.features) for d in it]
+        b = [np.asarray(d.features) for d in it]
+        assert len(a) == len(b)
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+
+
+class TestPackingMetrics:
+    def test_register_and_record(self):
+        from deeplearning4j_tpu.optimize.metrics import registry
+        register_packing_metrics()
+        reg = registry()
+        # pre-registered at 0 for both sources
+        assert reg.counter("packed_requests_total").value(
+            source="serve") >= 0.0
+        before = reg.counter("packed_requests_total").value(source="fit")
+        record_packing("fit", items=3, real_tokens=30, padded_tokens=64)
+        assert reg.counter("packed_requests_total").value(
+            source="fit") == before + 3
+        eff = reg.gauge("packing_efficiency").value(source="fit")
+        assert 0.0 < eff <= 1.0
+        fb = reg.counter("packing_fallback_total").value(source="serve")
+        record_packing("serve", fallbacks=2)
+        assert reg.counter("packing_fallback_total").value(
+            source="serve") == fb + 2
+
+
+@pytest.mark.slow
+class TestLossExactness:
+    def _net(self, feat=4, classes=3):
+        from deeplearning4j_tpu import (Adam, InputType, MultiLayerNetwork,
+                                        NeuralNetConfiguration,
+                                        RnnOutputLayer)
+        from deeplearning4j_tpu.nn.layers.attention import \
+            SelfAttentionLayer
+        conf = (NeuralNetConfiguration.builder().seed(5)
+                .updater(Adam(1e-3)).list()
+                .layer(SelfAttentionLayer(n_out=8, n_heads=2, causal=True,
+                                          packed_segments=True))
+                .layer(RnnOutputLayer(n_out=classes, activation="softmax",
+                                      loss="mcxent"))
+                .set_input_type(InputType.recurrent(feat)).build())
+        return MultiLayerNetwork(conf).init()
+
+    def test_packed_score_equals_unpacked(self):
+        # The loss contract, end to end: score on the packed batch must
+        # equal score on the unpacked ragged batch EXACTLY (numerator
+        # and denominator both equal sum over the same real tokens).
+        net = self._net()
+        lengths = [5, 7, 3, 6, 2, 4]
+        ragged = _ragged_batch(lengths, t=8, seed=3)
+        unpacked = net.score(ragged)
+        it = PackToBucketIterator(
+            ExistingDataSetIterator([ragged]), bucket_len=16)
+        packed_batches = list(it)
+        assert len(packed_batches) == 1
+        packed = net.score(packed_batches[0])
+        assert packed == unpacked, \
+            f"packed {packed!r} != unpacked {unpacked!r}"
+
+    def test_weighted_labels_mask_survives_packing(self):
+        net = self._net()
+        lengths = [4, 6]
+        user = np.zeros((2, 8), np.float32)
+        user[0, :4] = 0.5
+        user[1, :6] = 1.0
+        ragged = _ragged_batch(lengths, t=8, seed=4, lmask=user)
+        unpacked = net.score(ragged)
+        packed = net.score(next(iter(PackToBucketIterator(
+            ExistingDataSetIterator([ragged]), bucket_len=16))))
+        assert packed == unpacked

@@ -1,0 +1,274 @@
+"""In-kernel segment masks for packed variable-length batches (ISSUE 13).
+
+Parity is checked against an INDEPENDENT numpy reference (not
+impl-vs-impl): softmax attention where a q/k pair is admissible iff the
+segment ids match, the causal order holds, and the key mask allows the
+key — fully-masked queries output exactly zero (the dense_attention
+convention). All Pallas runs use interpret mode on CPU with 16-token
+blocks so the @pl.when block-skip (segment-range intersection x causal)
+is exercised on block-aligned segment layouts. Layer-level packed
+end-to-end (jit-heavy) rides the `slow` marker; tests/smoke_packing.py
+keeps a fast interpret-mode slice in the smoke gates.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ops import attention as att
+from deeplearning4j_tpu.ops import flash_attention as fa
+
+FWD_TOL = dict(rtol=1e-5, atol=1e-5)
+GRAD_TOL = dict(rtol=2e-4, atol=1e-5)
+
+
+def _qkv(seed=0, B=2, T=64, H=2, D=8, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(rng.standard_normal((B, T, H, D)), dtype)
+    return mk(), mk(), mk()
+
+
+def _segs_from_lengths(lengths, T, B=2):
+    """Per-token segment ids: 1..k over the given lengths, 0 pad tail.
+    Same row layout replicated across the batch (ids are per-row data;
+    replication keeps the reference simple)."""
+    row = np.zeros(T, np.int32)
+    ofs = 0
+    for s, n in enumerate(lengths, start=1):
+        row[ofs:ofs + n] = s
+        ofs += n
+    return jnp.asarray(np.broadcast_to(row, (B, T)).copy())
+
+
+def naive_segment_attention(q, k, v, qseg, kseg=None, causal=False,
+                            key_mask=None):
+    """Independent reference: f32 numpy softmax with explicit
+    admissibility (segment equality AND causal AND key mask); queries
+    with no admissible key output exactly 0."""
+    q, k, v = (np.asarray(a, np.float32) for a in (q, k, v))
+    B, T, H, D = q.shape
+    Tk = k.shape[1]
+    s = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(D)
+    kseg = qseg if kseg is None else kseg
+    allow = (np.asarray(qseg)[:, None, :, None]
+             == np.asarray(kseg)[:, None, None, :])
+    if causal:
+        allow = allow & (np.arange(T)[:, None]
+                         >= np.arange(Tk)[None, :])[None, None]
+    if key_mask is not None:
+        allow = allow & (np.asarray(key_mask) > 0)[:, None, None, :]
+    s = np.where(allow, s, -np.inf)
+    alive = allow.any(-1, keepdims=True)
+    m = np.where(alive, s.max(-1, keepdims=True), 0.0)
+    e = np.where(allow, np.exp(s - m), 0.0)
+    denom = e.sum(-1, keepdims=True)
+    p = np.where(alive, e / np.where(denom == 0.0, 1.0, denom), 0.0)
+    return np.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _flash(q, k, v, **kw):
+    kw.setdefault("q_block", 16)
+    kw.setdefault("kv_block", 16)
+    return fa.flash_attention(q, k, v, interpret=True, **kw)
+
+
+# ragged (block-straddling) and 16-aligned (block-skip-exercising)
+RAGGED = (23, 17, 11, 13)   # sums to 64
+ALIGNED = (16, 32, 16)      # every boundary on a 16-token block edge
+
+
+class TestSegmentForward:
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("lengths", [RAGGED, ALIGNED])
+    def test_flash_matches_naive(self, causal, lengths):
+        q, k, v = _qkv()
+        seg = _segs_from_lengths(lengths, q.shape[1])
+        got = _flash(q, k, v, causal=causal, segment_ids=seg)
+        want = naive_segment_attention(q, k, v, seg, causal=causal)
+        np.testing.assert_allclose(np.asarray(got), want, **FWD_TOL)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_all_impls_agree_with_naive(self, causal):
+        q, k, v = _qkv(seed=1)
+        seg = _segs_from_lengths(RAGGED, q.shape[1])
+        want = naive_segment_attention(q, k, v, seg, causal=causal)
+        for name, got in (
+                ("dense", att.dense_attention(q, k, v, causal=causal,
+                                              segment_ids=seg)),
+                ("blockwise", att.blockwise_attention(
+                    q, k, v, causal=causal, segment_ids=seg,
+                    q_block=16, kv_block=16)),
+                ("pallas", _flash(q, k, v, causal=causal,
+                                  segment_ids=seg))):
+            np.testing.assert_allclose(np.asarray(got), want,
+                                       err_msg=name, **FWD_TOL)
+
+    def test_pad_segment_zero_masked_by_key_mask(self):
+        # Packed-row convention: id 0 is padding. The key mask excludes
+        # pad KEYS, so real segments never attend to pad — and a pad
+        # QUERY (segment 0, all its same-id keys masked) has no
+        # admissible key at all, hence outputs exactly zero.
+        q, k, v = _qkv(seed=2)
+        lengths = (20, 24)  # 20 pad tokens
+        seg = _segs_from_lengths(lengths, q.shape[1])
+        km = (seg > 0).astype(jnp.float32)
+        got = _flash(q, k, v, segment_ids=seg, key_mask=km)
+        want = naive_segment_attention(q, k, v, seg, key_mask=km)
+        np.testing.assert_allclose(np.asarray(got), want, **FWD_TOL)
+        assert np.all(np.asarray(got)[:, 44:] == 0.0)
+
+    def test_cross_segment_blocks_fully_masked(self):
+        # Block-aligned single-segment-per-block layout: every
+        # off-diagonal (cross-segment) block is fully masked and the
+        # kernel's intersection predicate skips it — the result must
+        # equal running each segment's slice as its own attention call.
+        q, k, v = _qkv(seed=3)
+        lengths = (16, 16, 16, 16)
+        seg = _segs_from_lengths(lengths, q.shape[1])
+        got = np.asarray(_flash(q, k, v, causal=True, segment_ids=seg))
+        ofs = 0
+        for n in lengths:
+            solo = att.dense_attention(q[:, ofs:ofs + n], k[:, ofs:ofs + n],
+                                       v[:, ofs:ofs + n], causal=True)
+            np.testing.assert_allclose(got[:, ofs:ofs + n],
+                                       np.asarray(solo), **FWD_TOL)
+            ofs += n
+
+    def test_kv_segment_ids_cross_attention(self):
+        q, k, v = _qkv(seed=4)
+        qs = _segs_from_lengths((30, 34), q.shape[1])
+        ks = _segs_from_lengths((34, 30), k.shape[1])
+        got = _flash(q, k, v, segment_ids=qs, kv_segment_ids=ks)
+        want = naive_segment_attention(q, k, v, qs, kseg=ks)
+        np.testing.assert_allclose(np.asarray(got), want, **FWD_TOL)
+        dense = att.dense_attention(q, k, v, segment_ids=qs,
+                                    kv_segment_ids=ks)
+        np.testing.assert_allclose(np.asarray(dense), want, **FWD_TOL)
+
+    def test_uniform_position_offset_invariant(self):
+        # The ring path feeds global positions; shifting q and kv
+        # positions by the SAME offset must not change a causal
+        # segment-masked result (relative order is what causality uses).
+        q, k, v = _qkv(seed=5, B=1, T=32)
+        seg = _segs_from_lengths((13, 19), 32, B=1)
+        base = _flash(q, k, v, causal=True, segment_ids=seg)
+        off = _flash(q, k, v, causal=True, segment_ids=seg,
+                     q_pos=jnp.arange(32) + 100,
+                     kv_pos=jnp.arange(32) + 100)
+        np.testing.assert_allclose(np.asarray(off), np.asarray(base),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_kv_segment_ids_without_segment_ids_raises(self):
+        q, k, v = _qkv(B=1, T=16)
+        seg = _segs_from_lengths((16,), 16, B=1)
+        with pytest.raises(ValueError):
+            fa.flash_attention(q, k, v, kv_segment_ids=seg,
+                               interpret=True)
+        with pytest.raises(ValueError):
+            att.dense_attention(q, k, v, kv_segment_ids=seg)
+
+
+class TestSegmentBackward:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grads_match_dense(self, causal):
+        q, k, v = _qkv(seed=6, T=32)
+        seg = _segs_from_lengths((9, 14, 9), 32)
+        g = jnp.asarray(np.random.default_rng(7).standard_normal(q.shape),
+                        jnp.float32)
+
+        def mk_loss(fn):
+            return lambda q, k, v: jnp.sum(
+                fn(q, k, v, causal=causal, segment_ids=seg) * g)
+
+        want = jax.grad(mk_loss(att.dense_attention),
+                        argnums=(0, 1, 2))(q, k, v)
+        got = jax.grad(mk_loss(lambda *a, **kw: _flash(*a, **kw)),
+                       argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(got, want, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       err_msg=f"d{name}", **GRAD_TOL)
+
+    def test_no_cross_segment_gradient_leak(self):
+        # A loss that reads ONLY segment 1's outputs must produce
+        # exactly zero gradient on segment 2's keys/values — the
+        # segment wall holds in the backward pass too.
+        q, k, v = _qkv(seed=8, B=1, T=32)
+        seg = _segs_from_lengths((16, 16), 32, B=1)
+
+        def loss(q, k, v):
+            out = _flash(q, k, v, causal=True, segment_ids=seg)
+            return jnp.sum(out[:, :16] ** 2)
+
+        dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        assert np.all(np.asarray(dk)[:, 16:] == 0.0)
+        assert np.all(np.asarray(dv)[:, 16:] == 0.0)
+        assert np.all(np.asarray(dq)[:, 16:] == 0.0)
+        assert np.any(np.asarray(dk)[:, :16] != 0.0)
+
+    def test_bwd_acc_dtype_bf16_stays_close(self):
+        # The bwd_acc_dtype knob: bf16 accumulators must change grads
+        # only by rounding noise at this scale (the bench A/B measures
+        # the drift at the longctx geometry).
+        q, k, v = _qkv(seed=9, B=1, T=32)
+        g = jnp.asarray(np.random.default_rng(10).standard_normal(q.shape),
+                        jnp.float32)
+
+        def grads(acc):
+            def loss(q, k, v):
+                return jnp.sum(_flash(q, k, v, causal=True,
+                                      bwd_acc_dtype=acc) * g)
+            return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+        g32 = grads("float32")
+        g16 = grads("bfloat16")
+        for a, b in zip(g32, g16):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=0.0, atol=0.05)
+
+
+@pytest.mark.slow
+class TestPackedLayerEndToEnd:
+    def test_packed_layer_output_bitwise_matches_solo(self):
+        # The serving acceptance bar: a packed_segments layer's output
+        # for each segment is BITWISE identical to running that
+        # sequence alone (exp(NEG - m) underflows to exactly 0.0, so
+        # cross-segment terms vanish, not merely shrink).
+        from deeplearning4j_tpu import (Adam, InputType, MultiLayerNetwork,
+                                        NeuralNetConfiguration,
+                                        RnnOutputLayer)
+        from deeplearning4j_tpu.nn.layers.attention import \
+            SelfAttentionLayer
+        F = 8
+        conf = (NeuralNetConfiguration.builder().seed(5)
+                .updater(Adam(1e-3)).list()
+                .layer(SelfAttentionLayer(n_out=8, n_heads=2, causal=True,
+                                          packed_segments=True))
+                .layer(RnnOutputLayer(n_out=3, activation="softmax",
+                                      loss="mcxent"))
+                .set_input_type(InputType.recurrent(F)).build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        lengths = (5, 7, 4)
+        xs = [rng.standard_normal((1, n, F)).astype(np.float32)
+              for n in lengths]
+        solo = [np.asarray(net.output(x)) for x in xs]
+        T = 32
+        packed = np.zeros((1, T, F), np.float32)
+        seg = np.zeros((1, T), np.float32)
+        ofs = 0
+        for s, x in enumerate(xs, start=1):
+            n = x.shape[1]
+            packed[0, ofs:ofs + n] = x[0]
+            seg[0, ofs:ofs + n] = s
+            ofs += n
+        out = np.asarray(net.output(packed, features_mask=seg))
+        ofs = 0
+        for x, ref in zip(xs, solo):
+            n = x.shape[1]
+            assert np.all(out[:, ofs:ofs + n] == ref), \
+                f"segment at {ofs} not bitwise identical"
+            ofs += n
+        # pad tail: attention zeroes it, then the output softmax maps
+        # zeros to the uniform distribution — constant, input-free rows
+        pad = out[:, sum(lengths):]
+        assert np.allclose(pad, pad[:, :1]), "pad tail leaked input"
