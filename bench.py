@@ -928,6 +928,132 @@ def bench_serving(clients=8, requests_per_client=200, batch_limit=8):
     }
 
 
+def bench_serving_multimodel(heads=3, clients=6, requests_per_client=120,
+                             batch_limit=16, batch_timeout_ms=0.0):
+    """Multi-model serving aggregate requests/sec (docs/serving.md
+    §multi-model): N same-geometry heads served two ways on one device
+    budget — first as independent tiered entries (critical/standard/
+    batch, one continuous-batching engine each, WFQ-arbitrated), then as
+    ONE FusedModelGroup (a single channel-concatenated forward; every
+    member's traffic rides the shared batch). Each client is PINNED to
+    one head and sends single-row payloads with zero batch linger — the
+    thin-per-model regime fusion exists for: an independent engine sees
+    only its own head's trickle (rows/forward near 1) while the fused
+    engine coalesces all members' rows into one forward, so the speedup
+    measures cross-model coalescing, not intra-model batching. The
+    headline value is the fused aggregate rps; extras carry the
+    independent baseline, the speedup, the per-tier latency percentiles
+    from the tiered run, the typed tier-shed count, and the starvation
+    totals (nonzero only for the batch tier, and only while it actually
+    held queued work that higher tiers outranked — the pager signal the
+    counter exists for; it can never grow on an idle entry)."""
+    import queue as _queue
+    import threading
+    from deeplearning4j_tpu import (Adam, DenseLayer, InputType,
+                                    NeuralNetConfiguration, OutputLayer,
+                                    WeightInit)
+    from deeplearning4j_tpu.nn.graph.graph import ComputationGraph
+    from deeplearning4j_tpu.optimize.metrics import registry as _reg
+    from deeplearning4j_tpu.serving import (FusedModelGroup,
+                                            ServingGateway, TierShedError)
+
+    def head(seed):
+        conf = (NeuralNetConfiguration.builder().seed(seed)
+                .updater(Adam(1e-3)).weight_init(WeightInit.XAVIER)
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("dense",
+                           DenseLayer(n_out=128, activation="relu"), "in")
+                .add_layer("out",
+                           OutputLayer(n_out=10, activation="softmax",
+                                       loss="mcxent"), "dense")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(32))
+                .build())
+        return ComputationGraph(conf).init()
+
+    names = [f"head{i}" for i in range(heads)]
+    tiers = ("critical", "standard", "batch")
+    rng = np.random.default_rng(0)
+    payloads = [rng.standard_normal((1, 32)).astype(np.float32)
+                for i in range(16)]
+
+    def drive(gw):
+        errors: "_queue.Queue" = _queue.Queue()
+        done = [0] * clients
+        sheds = [0] * clients
+
+        def client(ci):
+            try:
+                nm = names[ci % heads]  # pinned: per-model traffic is thin
+                for j in range(requests_per_client):
+                    try:
+                        gw.predict(nm, payloads[(ci + j) % len(payloads)])
+                        done[ci] += 1
+                    except TierShedError:
+                        sheds[ci] += 1  # typed graceful degradation
+            except Exception as e:
+                errors.put(e)
+
+        for nm in names:  # seed EWMAs + lazy route state, unmeasured
+            gw.predict(nm, payloads[0])
+        _beat(repeat=1, phase="measure")
+        t0 = time.perf_counter()
+        ts = [threading.Thread(target=client, args=(i,))
+              for i in range(clients)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        dt = time.perf_counter() - t0
+        if not errors.empty():
+            raise errors.get()
+        return sum(done) / dt, sum(sheds)
+
+    # --- independent tiered baseline: one engine per head -------------
+    gw = ServingGateway()
+    for i, nm in enumerate(names):
+        gw.add_model(nm, head(7 + i), batch_limit=batch_limit,
+                     queue_limit=1024, batch_timeout_ms=batch_timeout_ms,
+                     tier=tiers[i % len(tiers)])
+    gw.warmup()
+    independent_rps, independent_sheds = drive(gw)
+    tier_lat = gw.stats().get("tiers", {})
+    gw.pool.shutdown()
+
+    # --- fused: the same heads as ONE concatenated forward ------------
+    gw = ServingGateway()
+    grp = gw.add_fused_group(
+        "fused", [(nm, head(7 + i)) for i, nm in enumerate(names)],
+        batch_limit=batch_limit, queue_limit=1024,
+        batch_timeout_ms=batch_timeout_ms, tier="critical", weight=2.0)
+    gw.warmup()
+    fused_rps, fused_sheds = drive(gw)
+    engine = gw.pool.get(names[0]).engine
+    forwards = max(1, engine.total_forwards)
+    served_rows = sum(engine.executed_batch_sizes)
+    gw.pool.shutdown()
+
+    reg = _reg()
+    return fused_rps, {
+        "heads": heads,
+        "clients": clients,
+        "fused_rps": round(fused_rps, 1),
+        "independent_rps": round(independent_rps, 1),
+        "fused_speedup": round(fused_rps / max(independent_rps, 1e-9), 2),
+        "fused_group": isinstance(grp, FusedModelGroup),
+        "rows_per_forward_fused": round(served_rows / forwards, 2),
+        "tier_latency_ms": {
+            t: {"p50": v.get("p50_ms", 0.0), "p99": v.get("p99_ms", 0.0)}
+            for t, v in tier_lat.items()},
+        "tier_sheds": int(independent_sheds + fused_sheds),
+        "starvation_total": int(reg.counter(
+            "serving_starvation_total").total()),
+        "sched_dispatches": int(reg.counter(
+            "serving_sched_dispatch_total").total()),
+    }
+
+
 def _vs_baseline(metric, value, backend=None):
     """Track best-so-far per metric in BENCH_baseline.json (atomic
     write, corrupt-file tolerant, backend-namespaced keys — all via
@@ -992,6 +1118,8 @@ _DEGRADED_KW = {
     "etl": dict(n_images=128, epochs=1),
     "lenet_hostfed": dict(batch=256, n_train=1024, epochs=1),
     "serving": dict(clients=2, requests_per_client=20),
+    "serving_multimodel": dict(clients=2, requests_per_client=20,
+                               batch_limit=8),
 }
 
 
@@ -1072,6 +1200,10 @@ def _dispatch_once(workload: str, arg, kw):
         rps, ext = bench_serving(**kw)
         return ("serving_gateway_requests_per_sec", rps, "requests/sec",
                 ext)
+    if workload == "serving_multimodel":
+        rps, ext = bench_serving_multimodel(**kw)
+        return ("serving_multimodel_requests_per_sec", rps,
+                "requests/sec", ext)
     if workload == "lenet_hostfed":
         ips, ext = bench_lenet_hostfed(**kw)
         return "lenet_mnist_hostfed_images_per_sec", ips, "images/sec", ext
@@ -1109,7 +1241,8 @@ def _dispatch_once(workload: str, arg, kw):
         "attention_longctx [seq] | "
         "attention_ab [seq] | attention_packed [bucket] | alexnet | "
         "alexnet_pallaslrn | lenet | lenet_tiny | lstm | w2v [scale] | "
-        "etl | lenet_hostfed | serving | check [metric...] | report")
+        "etl | lenet_hostfed | serving | serving_multimodel | "
+        "check [metric...] | report")
 
 
 def _register_metric_families():
@@ -1123,6 +1256,8 @@ def _register_metric_families():
     from deeplearning4j_tpu.optimize import resilience, scoreboard
     from deeplearning4j_tpu.parallel import cluster_health
     from deeplearning4j_tpu.serving import breaker as serving_breaker
+    from deeplearning4j_tpu.serving import model_pool as serving_pool
+    from deeplearning4j_tpu.serving import scheduler as serving_scheduler
     # Recovery counters (rollbacks/retries — docs/robustness.md),
     # serving-resilience families (breaker states, batch failures,
     # canary rejections — docs/serving.md), cluster-health families
@@ -1132,6 +1267,8 @@ def _register_metric_families():
     # families (bench_rows_total{status} et al).
     resilience.register_metrics()
     serving_breaker.register_metrics()
+    serving_scheduler.register_metrics()
+    serving_pool.register_metrics()
     cluster_health.register_metrics()
     pooling_ops.register_metrics()
     graph_fusion.register_metrics()
@@ -1396,14 +1533,20 @@ def main():
         # nominal before blaming the program
         row["regression"] = True
     scoreboard.register_metrics()
+    # A/B workloads (serving_multimodel fused-vs-independent) carry the
+    # comparison into the ledger row itself — `bench.py report` and the
+    # regression sentinel see the ratio without re-parsing artifacts.
+    ledger_extras = {"raw_times_s": med.get("raw_times_s", [])}
+    for k in ("fused_speedup", "independent_rps", "fused_group"):
+        if k in med:
+            ledger_extras[k] = med[k]
     _append_ledger(scoreboard.make_row(
         workload, "wedged" if wedge_failure else "ok", med["metric"],
         float(med["value"]), med["unit"], timeout=timed_out,
         failure=wedge_failure,
         repeats=[float(r["value"]) for r in runs], probe=probe,
         spread=row["spread"], vs_baseline=row["vs_baseline"],
-        backend=med.get("backend"),
-        extras={"raw_times_s": med.get("raw_times_s", [])}))
+        backend=med.get("backend"), extras=ledger_extras))
     print(json.dumps(row))
 
 

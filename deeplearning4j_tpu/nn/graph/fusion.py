@@ -50,8 +50,9 @@ from ...utils import serde
 from ..conf.graph_conf import ComputationGraphConfiguration, GraphNode, \
     _toposort
 from ..layers.convolution import ConvolutionLayer
+from ..layers.core import DenseLayer
 from ..updaters import GradientNormalization
-from .vertices import SubsetVertex
+from .vertices import MergeVertex, SubsetVertex
 
 
 @dataclass(frozen=True)
@@ -94,13 +95,14 @@ def register_metrics() -> None:
         fam.labels(outcome=outcome)
 
 
-def _fusion_key(layer: ConvolutionLayer):
+def _fusion_key(layer):
     """Everything that must MATCH for the concat rewrite to be exact.
     Serde JSON covers nested configs (updater, dist) without bespoke
-    equality."""
-    return (
-        tuple(layer.kernel_size), tuple(layer.stride), tuple(layer.padding),
-        tuple(layer.dilation), layer._mode().value, layer.conv_algo,
+    equality. Conv siblings additionally match on the full spatial
+    geometry; dense siblings (the multi-model serving heads) need only
+    the shared contraction shape."""
+    base = (
+        type(layer).__name__,
         layer.n_in, layer.activation,
         layer.l1, layer.l2, layer.l1_bias, layer.l2_bias,
         layer.frozen,
@@ -108,11 +110,23 @@ def _fusion_key(layer: ConvolutionLayer):
         serde.to_json(layer.dist) if layer.dist else None,
         layer.weight_init,
     )
+    if isinstance(layer, ConvolutionLayer):
+        base += (
+            tuple(layer.kernel_size), tuple(layer.stride),
+            tuple(layer.padding), tuple(layer.dilation),
+            layer._mode().value, layer.conv_algo,
+        )
+    return base
+
+
+# Strict types only: OutputLayer subclasses DenseLayer but carries a
+# loss head whose training walk differs — excluded by `type(...) is`.
+_FUSIBLE_TYPES = (ConvolutionLayer, DenseLayer)
 
 
 def _fusible(node: GraphNode, name: str,
              conf: ComputationGraphConfiguration) -> bool:
-    if not node.is_layer() or type(node.layer) is not ConvolutionLayer:
+    if not node.is_layer() or type(node.layer) not in _FUSIBLE_TYPES:
         return False
     if len(node.inputs) != 1 or node.preprocessor is not None:
         return False
@@ -186,11 +200,14 @@ def fuse_sibling_convs(conf: ComputationGraphConfiguration
 
 def _concat_leaves(*leaves):
     """Channel-concat per-branch leaves: HWIO kernels (rank 4) join on
-    the output-channel axis, biases (rank 1) end to end; anything else
+    the output-channel axis, dense kernels (rank 2, [n_in, n_out]) on
+    the output-feature axis, biases (rank 1) end to end; anything else
     (scalar schedules etc.) must already agree branch-to-branch."""
     a = leaves[0]
     if a.ndim == 4:
         return jnp.concatenate(leaves, axis=3)
+    if a.ndim == 2:
+        return jnp.concatenate(leaves, axis=1)
     if a.ndim == 1:
         return jnp.concatenate(leaves, axis=0)
     for other in leaves[1:]:
@@ -218,6 +235,8 @@ def fuse_params(groups: Sequence[FusionGroup], tree: Dict[str, dict]
 def _slice_leaf(leaf, off: int, n: int):
     if leaf.ndim == 4:
         return leaf[:, :, :, off:off + n]
+    if leaf.ndim == 2:
+        return leaf[:, off:off + n]
     if leaf.ndim == 1:
         return leaf[off:off + n]
     return leaf
@@ -236,6 +255,150 @@ def unfuse_params(groups: Sequence[FusionGroup], tree: Dict[str, dict]
             out[m] = jax.tree_util.tree_map(
                 lambda leaf: _slice_leaf(leaf, off, n), sub)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Multi-model serving merge (serving/model_pool.py FusedModelGroup substrate)
+# ---------------------------------------------------------------------------
+
+# Name of the synthetic concat head the merged serving graph ends in.
+SERVING_CONCAT = "serving_concat"
+
+
+class FusionIneligibleError(ValueError):
+    """The member set cannot be merged into one fused serving forward
+    (geometry/type/init mismatch). ModelPool catches this and falls back
+    to independent per-model entries — never a hard failure."""
+
+
+def _serving_member_ok(name: str, net) -> None:
+    """Raise FusionIneligibleError unless `net` is a single-input,
+    single-output, initialized ComputationGraph whose head is a sized
+    layer (the shapes the column slicing needs)."""
+    conf = getattr(net, "conf", None)
+    if not isinstance(conf, ComputationGraphConfiguration):
+        raise FusionIneligibleError(
+            f"member {name!r} is not a ComputationGraph (only graph "
+            "models can merge into a fused serving forward)")
+    if not getattr(net, "_initialized", False):
+        raise FusionIneligibleError(f"member {name!r} is not init()ed")
+    if len(conf.network_inputs) != 1 or len(conf.network_outputs) != 1:
+        raise FusionIneligibleError(
+            f"member {name!r} must have exactly one input and one "
+            f"output (has {len(conf.network_inputs)}/"
+            f"{len(conf.network_outputs)})")
+    if not conf.input_types:
+        raise FusionIneligibleError(
+            f"member {name!r} was built without set_input_types(...) — "
+            "the fused engine cannot warm its buckets")
+    head = conf.nodes[conf.network_outputs[0]]
+    if not head.is_layer() or getattr(head.layer, "n_out", 0) <= 0:
+        raise FusionIneligibleError(
+            f"member {name!r} head {conf.network_outputs[0]!r} has no "
+            "sized n_out to slice columns by")
+
+
+def merge_serving_conf(named_members: Sequence[Tuple[str, object]]
+                       ) -> Tuple[ComputationGraphConfiguration,
+                                  Dict[str, Tuple[int, int]]]:
+    """Merge N same-input-geometry single-head graphs into ONE inference
+    config: every member's nodes are cloned under a ``{member}/`` name
+    prefix, all members read one shared network input, and a final
+    MergeVertex (``serving_concat``) channel-concatenates the member
+    heads so one forward yields every member's output side by side.
+
+    Returns (merged_conf, col_slices) where ``col_slices[member] =
+    (offset, width)`` locates that member's columns in the concat.
+
+    The merged config is INFERENCE-ONLY: a MergeVertex consuming
+    OutputLayer heads is illegal for training (GraphBuilder's sink rule
+    exists for the training walk) — the serving walk runs heads as
+    plain forwards, which is exactly the semantics the gateway needs.
+
+    Raises :class:`FusionIneligibleError` when members diverge (not
+    graphs, different input types, duplicate names, <2 members)."""
+    if len(named_members) < 2:
+        raise FusionIneligibleError("a fused group needs >= 2 members")
+    names = [nm for nm, _ in named_members]
+    if len(set(names)) != len(names):
+        raise FusionIneligibleError(f"duplicate member names in {names}")
+    for nm, net in named_members:
+        _serving_member_ok(nm, net)
+    first = named_members[0][1].conf
+    for nm, net in named_members[1:]:
+        if net.conf.input_types != first.input_types:
+            raise FusionIneligibleError(
+                f"member {nm!r} input type {net.conf.input_types} != "
+                f"{first.input_types} — fused batching needs identical "
+                "input geometry")
+    shared_input = first.network_inputs[0]
+    nodes: Dict[str, GraphNode] = {}
+    heads: List[str] = []
+    col_slices: Dict[str, Tuple[int, int]] = {}
+    off = 0
+    for nm, net in named_members:
+        conf = net.conf
+        own_input = conf.network_inputs[0]
+        remap = lambda inp: shared_input if inp == own_input \
+            else f"{nm}/{inp}"
+        for node_name, node in conf.nodes.items():
+            nodes[f"{nm}/{node_name}"] = GraphNode(
+                inputs=[remap(i) for i in node.inputs],
+                layer=copy.deepcopy(node.layer),
+                vertex=copy.deepcopy(node.vertex),
+                preprocessor=copy.deepcopy(node.preprocessor))
+        head = conf.network_outputs[0]
+        heads.append(f"{nm}/{head}")
+        width = conf.nodes[head].layer.n_out
+        col_slices[nm] = (off, width)
+        off += width
+    nodes[SERVING_CONCAT] = GraphNode(inputs=heads, vertex=MergeVertex())
+    merged = ComputationGraphConfiguration(
+        network_inputs=[shared_input],
+        network_outputs=[SERVING_CONCAT],
+        nodes=nodes,
+        topo_order=_toposort(nodes, [shared_input]),
+        input_types=copy.deepcopy(first.input_types),
+        seed=first.seed)
+    return merged, col_slices
+
+
+def fused_trees_from_members(groups: Sequence[FusionGroup],
+                             named_members: Sequence[Tuple[str, object]]
+                             ) -> Tuple[Dict[str, dict], Dict[str, dict]]:
+    """(params_tree, state_tree) for the fused serving graph, built from
+    the members' CURRENT trees (namespace-prefix then fuse_params).
+    Leaves are copied, never aliased — the solo members stay the source
+    of truth and mutate independently (hot-swap rebuilds through here)."""
+    merged_p: Dict[str, dict] = {}
+    merged_s: Dict[str, dict] = {}
+    for nm, net in named_members:
+        for node, sub in net.params_tree.items():
+            merged_p[f"{nm}/{node}"] = sub
+        for node, sub in net.state_tree.items():
+            merged_s[f"{nm}/{node}"] = sub
+    own = lambda tree: jax.tree_util.tree_map(jnp.copy, tree)
+    return (own(fuse_params(groups, merged_p)),
+            own(fuse_params(groups, merged_s)))
+
+
+def build_fused_serving_net(named_members: Sequence[Tuple[str, object]]):
+    """Members -> ONE inference-only ComputationGraph serving all of
+    them: merge under name prefixes, run the sibling-fusion pass over
+    the merged config (same-geometry first layers collapse into one
+    concat-weight matmul/conv), and transfer the members' live params.
+
+    Returns (fused_net, groups, col_slices): run ``fused_net.output(x)``
+    once, slice ``[:, off:off+width]`` per member. Raises
+    :class:`FusionIneligibleError` when the member set cannot merge."""
+    from .graph import ComputationGraph
+    merged, col_slices = merge_serving_conf(named_members)
+    fused_conf, groups = fuse_sibling_convs(merged)
+    net = ComputationGraph(fused_conf).init(
+        dtype=named_members[0][1]._dtype)
+    net.params_tree, net.state_tree = fused_trees_from_members(
+        groups, named_members)
+    return net, groups, col_slices
 
 
 def fuse_graph(net):

@@ -369,8 +369,16 @@ class ComputationGraph(DeviceIterationMixin):
                     for name, o in zip(self.conf.network_outputs, outs_s)}
         if inference:
             self._output_fn.precompile(params_s, state_s, inputs_s, {})
-            self._loss_fn_jit.precompile(params_s, state_s, inputs_s,
-                                         labels_s, {}, {})
+            # Inference-only graphs may end in plain vertices (the fused
+            # serving concat, nn/graph/fusion.py) — no score path exists
+            # to compile for them.
+            scoreable = all(
+                self.conf.nodes[n].is_layer()
+                and self.conf.nodes[n].layer.is_output_layer()
+                for n in self.conf.network_outputs)
+            if scoreable:
+                self._loss_fn_jit.precompile(params_s, state_s, inputs_s,
+                                             labels_s, {}, {})
         if not train:
             return self
         opt_s = compile_cache_mod.abstract_like(self.opt_state)

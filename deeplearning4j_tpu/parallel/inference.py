@@ -70,15 +70,25 @@ class DeadlineExceededError(RuntimeError):
 
 
 class _Request:
-    __slots__ = ("x", "event", "result", "error", "deadline")
+    __slots__ = ("x", "event", "result", "error", "deadline", "transform",
+                 "tag")
 
-    def __init__(self, x: np.ndarray, deadline: Optional[float] = None):
+    def __init__(self, x: np.ndarray, deadline: Optional[float] = None,
+                 transform: Optional[Callable] = None,
+                 tag: Optional[str] = None):
         self.x = x
         self.event = threading.Event()
         self.result: Optional[np.ndarray] = None
         self.error: Optional[BaseException] = None
         # Absolute time.monotonic() seconds; None = no SLO.
         self.deadline = deadline
+        # Per-request output view: applied to this request's row slice
+        # after the scatter (a FusedModelGroup member's column slice). A
+        # raising transform fails ONLY this request, never batchmates.
+        self.transform = transform
+        # Routing identity for failure attribution (BatchExecutionError
+        # .request_tags) — the member name inside a fused group.
+        self.tag = tag
 
     def expired(self, now: Optional[float] = None) -> bool:
         return self.deadline is not None and \
@@ -156,6 +166,12 @@ class ParallelInference:
         self.on_shed: Optional[Callable] = None
         self.on_batch: Optional[Callable] = None
         self.on_batch_error: Optional[Callable] = None
+        # Cross-model device arbitration (serving/scheduler.py): when a
+        # DeviceScheduler is attached, every coalesced forward holds a
+        # scheduler slot for the duration of the dispatch. None (the
+        # default) keeps the exact pre-scheduler single-model path.
+        self.scheduler = None
+        self.sched_name: Optional[str] = None
         if inference_mode == InferenceMode.BATCHED:
             self._worker = threading.Thread(
                 target=self._collector_loop, name="ParallelInference-collector",
@@ -221,6 +237,17 @@ class ParallelInference:
         batches_ahead = self.queue_depth() // max(1, self.batch_limit)
         return (batches_ahead + 1) * svc
 
+    def _sched_slot(self, cost: float = 1.0):
+        """The device-budget gate for one coalesced forward: a WFQ slot
+        when a DeviceScheduler is attached, a no-op otherwise (so the
+        default single-model path is untouched). Entered INSIDE
+        self._lock — a paused() hot-swap therefore never parks holding
+        the shared dispatch slot, and the scheduler takes no engine
+        locks, so the ordering cannot deadlock."""
+        if self.scheduler is None:
+            return contextlib.nullcontext()
+        return self.scheduler.slot(self.sched_name or "?", cost=cost)
+
     @contextlib.contextmanager
     def paused(self):
         """Hold the execution lock: the in-flight forward (if any)
@@ -232,7 +259,9 @@ class ParallelInference:
             yield self
 
     # ----------------------------------------------------------------- output
-    def output(self, x, *, deadline: Optional[float] = None) -> np.ndarray:
+    def output(self, x, *, deadline: Optional[float] = None,
+               transform: Optional[Callable] = None,
+               tag: Optional[str] = None) -> np.ndarray:
         """Predict for one request (any leading batch size). Thread-safe;
         in BATCHED mode blocks until the coalesced forward containing this
         request completes (reference output() → observable wait).
@@ -242,7 +271,12 @@ class ParallelInference:
         :class:`DeadlineExceededError` instead of riding a forward it
         can no longer use (the gateway's SLO shed contract). A full
         admission queue raises :class:`QueueFullError` (backpressure),
-        a closed server :class:`ServerClosedError`."""
+        a closed server :class:`ServerClosedError`.
+
+        `transform` post-processes this request's own row slice before
+        the caller sees it (a fused group's member-column view); a
+        raising transform fails only this request. `tag` names the
+        request for failure attribution (``err.request_tags``)."""
         x = np.asarray(x)
         if x.ndim == 0:
             raise ValueError("Request must have a leading batch dimension")
@@ -251,21 +285,24 @@ class ParallelInference:
                 raise ServerClosedError(
                     "ParallelInference has been shut down")
             with self._lock:
-                req = _Request(x, deadline)
+                req = _Request(x, deadline, transform, tag)
                 if req.expired():
                     self._shed(req, "expired")
                     raise DeadlineExceededError(
                         "deadline passed before dispatch")
                 try:
-                    out = self._forward(x)
+                    with self._sched_slot(float(x.shape[0])):
+                        out = self._forward(x)
                     self._require_finite(out)
+                    if transform is not None:
+                        out = transform(out)
                 except (DeadlineExceededError, QueueFullError,
                         ServerClosedError):
                     raise
                 except BaseException as e:
-                    raise self._batch_failure(e, 1)
+                    raise self._batch_failure(e, 1, reqs=[req])
                 return out
-        req = _Request(x, deadline)
+        req = _Request(x, deadline, transform, tag)
         # Enqueue under the same lock shutdown() uses to place its sentinel,
         # so no request can ever land BEHIND the sentinel and starve.
         with self._enqueue_lock:
@@ -292,6 +329,21 @@ class ParallelInference:
             except Exception:
                 pass  # a broken hook must never take the server down
 
+    def _finish(self, r: _Request, rows) -> None:
+        """Deliver one request's row slice, through its transform when it
+        carries one. A raising transform (e.g. a fused member's column
+        turned non-finite) fails ONLY this request — batchmates already
+        have (or will get) their own slices."""
+        if r.transform is not None:
+            try:
+                rows = r.transform(rows)
+            except BaseException as te:
+                r.error = self._batch_failure(te, 1, reqs=[r])
+                r.event.set()
+                return
+        r.result = rows
+        r.event.set()
+
     def _forward(self, x: np.ndarray) -> np.ndarray:
         # Chaos seam (docs/robustness.md): armed "serve.forward" plans
         # fail or delay this forward deterministically by call ordinal.
@@ -303,16 +355,23 @@ class ParallelInference:
             raise NonFiniteOutputError(
                 "forward returned non-finite (NaN/Inf) outputs")
 
-    def _batch_failure(self, e: BaseException,
-                       n_requests: int) -> BatchExecutionError:
+    def _batch_failure(self, e: BaseException, n_requests: int,
+                       reqs: Optional[List[_Request]] = None
+                       ) -> BatchExecutionError:
         """Record one failed forward attempt and return the typed error
-        the affected callers will see (original exception chained)."""
+        the affected callers will see (original exception chained).
+        When the failed requests are known, their tags ride along as
+        ``err.request_tags`` so a shared-engine hook (FusedModelGroup)
+        can attribute the failure to the right member breakers without
+        changing the on_batch_error signature."""
         if isinstance(e, BatchExecutionError):
             err = e
         else:
             err = BatchExecutionError(
                 f"forward failed for a {n_requests}-request batch: {e}")
             err.__cause__ = e
+        if reqs is not None and not hasattr(err, "request_tags"):
+            err.request_tags = [r.tag for r in reqs]
         self.total_batch_failures += 1
         cb = self.on_batch_error
         if cb is not None:
@@ -447,7 +506,8 @@ class ParallelInference:
             xs = repeat_tail_rows(xs, bucket - n)
             t0 = time.perf_counter()
             with self._lock:
-                out = self._forward(xs)
+                with self._sched_slot(float(n)):
+                    out = self._forward(xs)
                 dur = time.perf_counter() - t0
                 # EWMA seeds on the first forward, then smooths at 0.2 —
                 # reactive enough for the admission estimate, stable
@@ -466,16 +526,15 @@ class ParallelInference:
             ofs = 0
             for r in batch:
                 k = r.x.shape[0]
-                r.result = out[ofs:ofs + k]
+                self._finish(r, out[ofs:ofs + k])
                 ofs += k
-                r.event.set()
         except BaseException as e:
             # Batch-failure isolation: the failed forward is recorded
             # (on_batch_error feeds the breaker + metrics), the affected
             # futures fail with a TYPED error, and the collector thread
             # survives to run the next batch — a raising forward never
             # strands a caller and never kills the engine.
-            err = self._batch_failure(e, len(batch))
+            err = self._batch_failure(e, len(batch), reqs=batch)
             if len(batch) == 1:
                 batch[0].error = err
                 batch[0].event.set()
@@ -599,8 +658,9 @@ class ParallelInference:
                 ofs += t_i
             t0 = time.perf_counter()
             with self._lock:
-                faults.fire("serve.forward")
-                out = self.model.output(xs, features_mask=segmask)
+                with self._sched_slot(float(len(batch))):
+                    faults.fire("serve.forward")
+                    out = self.model.output(xs, features_mask=segmask)
                 dur = time.perf_counter() - t0
                 self._ewma_batch_s = dur if self._ewma_batch_s <= 0.0 \
                     else 0.8 * self._ewma_batch_s + 0.2 * dur
@@ -622,11 +682,10 @@ class ParallelInference:
             ofs = 0
             for r in batch:
                 t_i = r.x.shape[1]
-                r.result = out[:, ofs:ofs + t_i]
+                self._finish(r, out[:, ofs:ofs + t_i])
                 ofs += t_i
-                r.event.set()
         except BaseException as e:
-            err = self._batch_failure(e, len(batch))
+            err = self._batch_failure(e, len(batch), reqs=batch)
             if len(batch) == 1:
                 batch[0].error = err
                 batch[0].event.set()

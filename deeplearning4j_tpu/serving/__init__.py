@@ -1,10 +1,13 @@
 """Serving: the gateway control plane (continuous batching, SLO
 shedding, per-model circuit breakers, checkpoint-gated hot-swap with a
-canary gate — docs/serving.md) plus the k-NN and Keras-backend REST
-facades (reference deeplearning4j-nearestneighbor-server, SURVEY.md
-§2.11), all on the shared utils/http_server core."""
+canary gate, priority-tier WFQ scheduling across co-resident models and
+fused cross-model batching — docs/serving.md) plus the k-NN and
+Keras-backend REST facades (reference
+deeplearning4j-nearestneighbor-server, SURVEY.md §2.11), all on the
+shared utils/http_server core."""
 from .breaker import BreakerOpenError, CircuitBreaker
 from .gateway import ServingGateway
 from .keras_server import KerasBackendServer
-from .model_pool import ModelEntry, ModelPool, SwapError
+from .model_pool import FusedModelGroup, ModelEntry, ModelPool, SwapError
 from .nearest_neighbor import NearestNeighbor, NearestNeighborsServer
+from .scheduler import DeviceScheduler, TierShedError

@@ -29,9 +29,20 @@ reports `degraded` while any breaker is not closed. Forward failures
 surface as typed 5xx statuses (`batch_failed` / `nonfinite`), never
 hangs.
 
-Endpoints: POST /predict, POST /swap, GET /health, GET /models,
-GET /stats, GET /metrics (Prometheus exposition — scrape surface shared
-with UIServer, docs/observability.md). Metrics:
+Multi-model scale (docs/serving.md §multi-model): when the pool carries
+a DeviceScheduler, admission adds a TIER check — a lower-tier request is
+shed with a typed 503 `tier_shed` while a strictly-higher tier's queue
+is saturated — and per-tier latency rides
+`serving_latency_ms{tier=...}` histograms plus scrape-time
+`serving_tier_p99_ms{tier}` gauges (judged against the scheduler's
+`serving_tier_slo_ms{tier}`). Fused-group members route exactly like
+ordinary models: `/predict` carries the member name, the entry's
+transform slices its columns out of the shared fused forward.
+
+Endpoints: POST /predict, POST /swap, POST /config (live tier/weight/
+packed-admission reconfiguration), GET /health, GET /models, GET /stats,
+GET /metrics (Prometheus exposition — scrape surface shared with
+UIServer, docs/observability.md). Metrics:
 `serving_requests_total{model,status}`, `serving_admitted_total`,
 `serving_shed_total{model,reason}`, `serving_swaps_total{model,outcome}`,
 `serving_queue_depth{model}`, `serving_batch_failures_total{model}`,
@@ -58,6 +69,7 @@ from ..parallel.inference import (BatchExecutionError, DeadlineExceededError,
 from ..utils.http_server import JsonHttpServer
 from .breaker import BreakerOpenError
 from .model_pool import ModelPool, SwapError
+from .scheduler import TierShedError
 
 __all__ = ["ServingGateway"]
 
@@ -91,7 +103,8 @@ class ServingGateway(JsonHttpServer):
                         "/models": self._models_route,
                         "/stats": self._stats_route},
             post_routes={"/predict": self._predict_route,
-                         "/swap": self._swap_route},
+                         "/swap": self._swap_route,
+                         "/config": self._config_route},
             port=port, pool_size=pool_size, expose_metrics=True)
         self.pool = pool if pool is not None else ModelPool()
         self.default_deadline_ms = default_deadline_ms
@@ -100,6 +113,9 @@ class ServingGateway(JsonHttpServer):
         # Recent per-model latencies for p50/p99 (bounded: a gateway
         # lives for days) — the registry histogram is the durable record.
         self._latencies: Dict[str, collections.deque] = {}
+        # Per-TIER latency windows (only populated when the pool runs a
+        # DeviceScheduler — tier labels mean nothing without one).
+        self._tier_latencies: Dict[str, collections.deque] = {}
         reg = registry()
         self._req_c = reg.counter(
             "serving_requests_total",
@@ -120,6 +136,12 @@ class ServingGateway(JsonHttpServer):
     def add_model(self, name: str, model, **kw):
         """pool.add passthrough (see ModelPool.add for knobs)."""
         return self.pool.add(name, model, **kw)
+
+    def add_fused_group(self, group_name: str, members, **kw):
+        """pool.add_fused_group passthrough: N same-geometry models
+        behind one fused forward (falls back to independent entries
+        when the member set cannot merge)."""
+        return self.pool.add_fused_group(group_name, members, **kw)
 
     def warmup(self, name: Optional[str] = None, **kw) -> "ServingGateway":
         self.pool.warmup(name, **kw)
@@ -160,6 +182,22 @@ class ServingGateway(JsonHttpServer):
                     raise BreakerOpenError(
                         f"model {name!r} circuit breaker is "
                         f"{br.state} — fast-failing without queuing")
+                # Tier shed (docs/serving.md §multi-model): under
+                # saturation a lower-tier request must not take a queue
+                # slot behind traffic that always outranks it — typed
+                # 503, immediately, never a hang.
+                sch = self.pool.scheduler
+                if sch is not None:
+                    sname = entry.engine.sched_name or name
+                    shed_reason = sch.should_shed(sname)
+                    if shed_reason is not None:
+                        self._shed_c.labels(model=name,
+                                            reason=shed_reason).inc()
+                        status = "shed"
+                        raise TierShedError(
+                            f"model {name!r} (tier {entry.tier!r}) shed: "
+                            "a higher tier's backlog saturates the "
+                            "shared device budget")
                 if deadline is not None:
                     # SLO-aware admission: estimated completion past the
                     # deadline means this request can only waste a queue
@@ -175,7 +213,9 @@ class ServingGateway(JsonHttpServer):
                             "admission")
                 self._admit_c.labels(model=name).inc()
                 try:
-                    out = entry.engine.output(x, deadline=deadline)
+                    out = entry.engine.output(
+                        x, deadline=deadline, transform=entry.transform,
+                        tag=name)
                 except QueueFullError:
                     self._shed_c.labels(model=name,
                                         reason="queue_full").inc()
@@ -192,6 +232,11 @@ class ServingGateway(JsonHttpServer):
             dur_ms = (time.perf_counter() - t0) * 1000.0
             self._req_c.labels(model=name, status=status).inc()
             self._lat_h.labels(model=name).observe(dur_ms)
+            # Tier-labeled children only exist when a scheduler ranks
+            # the pool (keeps the default single-model scrape bitwise).
+            tiered = self.pool.scheduler is not None
+            if tiered:
+                self._lat_h.labels(tier=entry.tier).observe(dur_ms)
             if status == "ok":
                 with self._lat_lock:
                     dq = self._latencies.get(name)
@@ -199,6 +244,13 @@ class ServingGateway(JsonHttpServer):
                         dq = self._latencies.setdefault(
                             name, collections.deque(maxlen=2048))
                     dq.append(dur_ms)
+                    if tiered:
+                        tq = self._tier_latencies.get(entry.tier)
+                        if tq is None:
+                            tq = self._tier_latencies.setdefault(
+                                entry.tier,
+                                collections.deque(maxlen=2048))
+                        tq.append(dur_ms)
 
     # ---------------------------------------------------------------- stats
     def stats(self) -> Dict[str, Any]:
@@ -214,6 +266,15 @@ class ServingGateway(JsonHttpServer):
                          "p99_ms": round(_percentile(vals, 0.99), 3),
                          "count": len(vals)}
         out["latency"] = lat
+        with self._lat_lock:
+            titems = [(t, sorted(d))
+                      for t, d in self._tier_latencies.items()]
+        if titems:
+            out["tiers"] = {
+                t: {"p50_ms": round(_percentile(v, 0.50), 3),
+                    "p99_ms": round(_percentile(v, 0.99), 3),
+                    "count": len(v)}
+                for t, v in titems}
         return out
 
     def _collect_percentiles(self, reg) -> None:
@@ -223,9 +284,18 @@ class ServingGateway(JsonHttpServer):
                         "p99 gateway latency over the recent window")
         with self._lat_lock:
             items = [(n, sorted(d)) for n, d in self._latencies.items()]
+            titems = [(t, sorted(d))
+                      for t, d in self._tier_latencies.items()]
         for name, vals in items:
             g50.labels(model=name).set(_percentile(vals, 0.50))
             g99.labels(model=name).set(_percentile(vals, 0.99))
+        if titems:
+            tg = reg.gauge(
+                "serving_tier_p99_ms",
+                "p99 gateway latency per priority tier over the recent "
+                "window (compare against serving_tier_slo_ms)")
+            for t, vals in titems:
+                tg.labels(tier=t).set(_percentile(vals, 0.99))
 
     # ------------------------------------------------------------ lifecycle
     def stop(self):
@@ -263,6 +333,9 @@ class ServingGateway(JsonHttpServer):
         except BreakerOpenError as e:
             return 503, {"status": "unavailable", "reason": "breaker_open",
                          "error": str(e)}
+        except TierShedError as e:
+            return 503, {"status": "shed", "reason": "tier_shed",
+                         "error": str(e)}
         except QueueFullError as e:
             return 429, {"status": "shed", "reason": "queue_full",
                          "error": str(e)}
@@ -290,3 +363,31 @@ class ServingGateway(JsonHttpServer):
             return 404, {"status": "error", "error": str(e)}
         except SwapError as e:
             return 409, {"status": "swap_failed", "error": str(e)}
+
+    def _config_route(self, req: dict):
+        """Live per-entry reconfiguration: packed admission (the PR-12
+        HTTP knob), tier, WFQ weight. Body: {"model": ...,
+        "packed_admission": bool?, "pack_bucket": int?, "tier": str?,
+        "weight": float?}. 409 on invalid combinations (unknown tier,
+        fused-group member)."""
+        name = req.get("model", "default")
+        kw = {}
+        if "packed_admission" in req:
+            kw["packed_admission"] = bool(req["packed_admission"])
+        if "pack_bucket" in req:
+            kw["pack_bucket"] = int(req["pack_bucket"])
+        if "tier" in req:
+            kw["tier"] = req["tier"]
+        if "weight" in req:
+            kw["weight"] = float(req["weight"])
+        if not kw:
+            return 400, {"status": "error",
+                         "error": "no reconfigurable knob in request "
+                                  "(packed_admission/pack_bucket/tier/"
+                                  "weight)"}
+        try:
+            return 200, self.pool.reconfigure(name, **kw)
+        except KeyError as e:
+            return 404, {"status": "error", "error": str(e)}
+        except ValueError as e:
+            return 409, {"status": "error", "error": str(e)}

@@ -57,8 +57,9 @@ from ..utils.model_serializer import (PARAMS_ENTRY, STATE_ENTRY,
                                       _npz_bytes_to_tree, _read_entry,
                                       validate_checkpoint)
 from .breaker import STATE_VALUES, CircuitBreaker
+from .scheduler import DeviceScheduler, TIER_VALUES
 
-__all__ = ["ModelEntry", "ModelPool", "SwapError"]
+__all__ = ["FusedModelGroup", "ModelEntry", "ModelPool", "SwapError"]
 
 
 class SwapError(RuntimeError):
@@ -81,6 +82,24 @@ def _swap_counter(name: str, outcome: str):
         ).labels(model=name, outcome=outcome).inc()
 
 
+def _fused_fallback_counter(reason: str, n: int = 1):
+    registry().counter(
+        "serving_fused_fallback_total",
+        "Members served per-model instead of fused, by reason "
+        "(ineligible/ejected/dissolved)"
+        ).labels(reason=reason).inc(n)
+
+
+def register_metrics() -> None:
+    """Pre-register the pool's fused-serving families (bench --once)."""
+    fam = registry().counter(
+        "serving_fused_fallback_total",
+        "Members served per-model instead of fused, by reason "
+        "(ineligible/ejected/dissolved)")
+    for reason in ("ineligible", "ejected", "dissolved"):
+        fam.labels(reason=reason)
+
+
 def _golden_forward(model, golden: np.ndarray) -> np.ndarray:
     """Run the golden batch through the model padded to its pow2 bucket
     (the same rule the engine coalesces to, so a warmed server compiles
@@ -97,12 +116,23 @@ class ModelEntry:
     def __init__(self, name: str, model, engine: ParallelInference,
                  checkpoints=None, breaker: Optional[CircuitBreaker] = None,
                  golden_batch: Optional[np.ndarray] = None,
-                 canary_max_drift: Optional[float] = None):
+                 canary_max_drift: Optional[float] = None,
+                 tier: str = "standard", weight: float = 1.0):
         self.name = name
         self.model = model
         self.engine = engine
         self.checkpoints = checkpoints
         self.breaker = breaker
+        # Priority tier + WFQ weight (serving/scheduler.py). Defaults
+        # never construct a scheduler — single-model pools keep the
+        # exact pre-scheduler dispatch path.
+        self.tier = tier
+        self.weight = float(weight)
+        # Fused-group plumbing: members of a FusedModelGroup share one
+        # engine; `transform` slices this member's output columns out of
+        # the fused forward, `group` owns the per-member swap protocol.
+        self.transform = None
+        self.group: Optional["FusedModelGroup"] = None
         # Canary substrate: a small retained input batch (provided, or
         # captured from the first served request) replayed through new
         # params before a swap promotes them; `canary_max_drift` bounds
@@ -127,7 +157,11 @@ class ModelEntry:
             "total_forwards": self.engine.total_forwards,
             "total_shed": self.engine.total_shed,
             "total_batch_failures": self.engine.total_batch_failures,
+            "tier": self.tier,
+            "weight": self.weight,
         }
+        if self.group is not None:
+            out["fused_group"] = self.group.name
         if self.breaker is not None:
             out["breaker"] = self.breaker.describe()
         return out
@@ -136,9 +170,14 @@ class ModelEntry:
 class ModelPool:
     """Thread-safe name → ModelEntry routing table + swap protocol."""
 
-    def __init__(self):
+    def __init__(self, scheduler: Optional[DeviceScheduler] = None):
         self._lock = threading.Lock()
         self._entries: Dict[str, ModelEntry] = {}
+        # Cross-entry device arbitration (serving/scheduler.py). None
+        # until a caller passes one or an add() names a non-default
+        # tier/weight — a pool that never does keeps the exact
+        # pre-scheduler behavior (satellite: bitwise default).
+        self.scheduler = scheduler
         # Weakly-referenced scrape collector: queue depth is sampled at
         # scrape time only (never in the request path), and a dead pool
         # silently drops out of the scrape.
@@ -161,67 +200,60 @@ class ModelPool:
 
         registry().register_collector(_collect)
 
-    # ------------------------------------------------------------- routing
-    def add(self, name: str, model, *, checkpoints=None,
-            batch_limit: int = 32, queue_limit: int = 256,
-            batch_timeout_ms: float = 2.0,
-            inference_mode: InferenceMode = InferenceMode.BATCHED,
-            check_finite: bool = True,
-            breaker: Optional[CircuitBreaker] = None,
-            breaker_threshold: int = 5,
-            breaker_reset_s: float = 30.0,
-            golden_batch=None,
-            canary_max_drift: Optional[float] = None,
-            packed_admission: bool = False,
-            pack_bucket: int = 0) -> ModelEntry:
-        """Register an init()ed model under `name` behind a fresh
-        continuous-batching engine. `checkpoints` (a CheckpointManager
-        or a directory path) enables hot-swap for this entry.
+    # ----------------------------------------------------------- scheduling
+    def _ensure_scheduler(self) -> DeviceScheduler:
+        """Create the shared DeviceScheduler on first demand and
+        retro-register every existing entry at its recorded tier/weight
+        (entries added before any priority was expressed default to
+        standard/1.0 — the same arbitration-neutral values)."""
+        if self.scheduler is None:
+            self.scheduler = DeviceScheduler()
+            for e in self.entries():
+                self._sched_register(e)
+        return self.scheduler
 
-        Resilience knobs (docs/serving.md): `check_finite` fails a
-        forward whose outputs carry NaN/Inf (on by default for served
-        entries — the breaker's instant trip); `breaker` (or
-        `breaker_threshold`/`breaker_reset_s` for the default one)
-        guards this entry's /predict path; `golden_batch` seeds the
-        swap canary input (otherwise the first served request's rows
-        are retained); `canary_max_drift` bounds output drift a swap
-        may introduce on the golden batch (None = finiteness only);
-        `packed_admission`/`pack_bucket` coalesce short sequence
-        requests into one segment-masked [1, pack_bucket] row (the
-        model's attention layers must run packed_segments=True —
-        docs/serving.md §packed)."""
-        if isinstance(checkpoints, (str, os.PathLike)):
-            from ..optimize.resilience import CheckpointManager
-            checkpoints = CheckpointManager(checkpoints)
-        engine = ParallelInference(
-            model, inference_mode=inference_mode, batch_limit=batch_limit,
-            queue_limit=queue_limit, batch_timeout_ms=batch_timeout_ms,
-            check_finite=check_finite, packed_admission=packed_admission,
-            pack_bucket=pack_bucket)
-        if breaker is None:
-            breaker = CircuitBreaker(name,
-                                     failure_threshold=breaker_threshold,
-                                     reset_timeout_s=breaker_reset_s)
-        entry = ModelEntry(name, model, engine, checkpoints,
-                           breaker=breaker, golden_batch=golden_batch,
-                           canary_max_drift=canary_max_drift)
-        # Engine-level telemetry hooks: late (in-queue) deadline sheds,
-        # per-forward batch stats, and batch failures, labeled by model.
+    def _sched_register(self, entry: ModelEntry) -> None:
+        """Register one entry (or its fused group) with the scheduler
+        and point its engine at the shared dispatch slot. A fused
+        group's members schedule as ONE unit under the group name."""
+        sch = self.scheduler
+        if sch is None:
+            return
+        sched_name = entry.group.name if entry.group is not None \
+            else entry.name
+        sch.register(sched_name, tier=entry.tier, weight=entry.weight,
+                     depth_fn=entry.engine.queue_depth)
+        entry.engine.scheduler = sch
+        entry.engine.sched_name = sched_name
+
+    # ------------------------------------------------------------- routing
+    def _serving_families(self):
+        """The per-engine telemetry families (registry dedups by name)."""
         reg = registry()
-        shed_c = reg.counter(
-            "serving_shed_total",
-            "Requests shed before a forward served them, by reason")
-        fwd_c = reg.counter("serving_forwards_total",
-                            "Coalesced forward passes executed")
-        rows_c = reg.counter("serving_rows_total",
-                             "Real (un-padded) request rows served")
-        fill_h = reg.histogram(
-            "serving_batch_rows",
-            "Real rows per coalesced forward (bucket fill)")
-        fail_c = reg.counter(
-            "serving_batch_failures_total",
-            "Coalesced forwards that raised or returned non-finite "
-            "outputs")
+        return (
+            reg.counter(
+                "serving_shed_total",
+                "Requests shed before a forward served them, by reason"),
+            reg.counter("serving_forwards_total",
+                        "Coalesced forward passes executed"),
+            reg.counter("serving_rows_total",
+                        "Real (un-padded) request rows served"),
+            reg.histogram(
+                "serving_batch_rows",
+                "Real rows per coalesced forward (bucket fill)"),
+            reg.counter(
+                "serving_batch_failures_total",
+                "Coalesced forwards that raised or returned non-finite "
+                "outputs"),
+        )
+
+    def _wire_hooks(self, entry: ModelEntry) -> None:
+        """Engine-level telemetry hooks for a single-model entry: late
+        (in-queue) deadline sheds, per-forward batch stats, and batch
+        failures, labeled by model; breaker success/failure per
+        forward."""
+        shed_c, fwd_c, rows_c, fill_h, fail_c = self._serving_families()
+        name, breaker = entry.name, entry.breaker
 
         def _on_shed(req, reason, _name=name):
             shed_c.labels(model=_name, reason=reason).inc()
@@ -242,14 +274,221 @@ class ModelPool:
             _breaker.record_failure(
                 trip=isinstance(exc, NonFiniteOutputError))
 
-        engine.on_shed = _on_shed
-        engine.on_batch = _on_batch
-        engine.on_batch_error = _on_batch_error
+        entry.engine.on_shed = _on_shed
+        entry.engine.on_batch = _on_batch
+        entry.engine.on_batch_error = _on_batch_error
+
+    def add(self, name: str, model, *, checkpoints=None,
+            batch_limit: int = 32, queue_limit: int = 256,
+            batch_timeout_ms: float = 2.0,
+            inference_mode: InferenceMode = InferenceMode.BATCHED,
+            check_finite: bool = True,
+            breaker: Optional[CircuitBreaker] = None,
+            breaker_threshold: int = 5,
+            breaker_reset_s: float = 30.0,
+            golden_batch=None,
+            canary_max_drift: Optional[float] = None,
+            packed_admission: bool = False,
+            pack_bucket: int = 0,
+            tier: str = "standard",
+            weight: float = 1.0) -> ModelEntry:
+        """Register an init()ed model under `name` behind a fresh
+        continuous-batching engine. `checkpoints` (a CheckpointManager
+        or a directory path) enables hot-swap for this entry.
+
+        Resilience knobs (docs/serving.md): `check_finite` fails a
+        forward whose outputs carry NaN/Inf (on by default for served
+        entries — the breaker's instant trip); `breaker` (or
+        `breaker_threshold`/`breaker_reset_s` for the default one)
+        guards this entry's /predict path; `golden_batch` seeds the
+        swap canary input (otherwise the first served request's rows
+        are retained); `canary_max_drift` bounds output drift a swap
+        may introduce on the golden batch (None = finiteness only);
+        `packed_admission`/`pack_bucket` coalesce short sequence
+        requests into one segment-masked [1, pack_bucket] row (the
+        model's attention layers must run packed_segments=True —
+        docs/serving.md §packed).
+
+        Priority knobs (docs/serving.md §multi-model): `tier`
+        (critical/standard/batch) and `weight` (WFQ share within the
+        tier) rank this entry against its pool-mates under saturation.
+        Naming a non-default tier or weight creates the pool's shared
+        DeviceScheduler on the spot (and retro-registers every existing
+        entry); all-default pools never construct one and keep the
+        exact single-model dispatch path."""
+        if tier not in TIER_VALUES:
+            raise ValueError(f"unknown tier {tier!r}; one of "
+                             f"{tuple(TIER_VALUES)}")
+        if isinstance(checkpoints, (str, os.PathLike)):
+            from ..optimize.resilience import CheckpointManager
+            checkpoints = CheckpointManager(checkpoints)
+        engine = ParallelInference(
+            model, inference_mode=inference_mode, batch_limit=batch_limit,
+            queue_limit=queue_limit, batch_timeout_ms=batch_timeout_ms,
+            check_finite=check_finite, packed_admission=packed_admission,
+            pack_bucket=pack_bucket)
+        if breaker is None:
+            breaker = CircuitBreaker(name,
+                                     failure_threshold=breaker_threshold,
+                                     reset_timeout_s=breaker_reset_s)
+        entry = ModelEntry(name, model, engine, checkpoints,
+                           breaker=breaker, golden_batch=golden_batch,
+                           canary_max_drift=canary_max_drift,
+                           tier=tier, weight=weight)
+        self._wire_hooks(entry)
         with self._lock:
             if name in self._entries:
                 raise ValueError(f"model {name!r} already registered")
             self._entries[name] = entry
+        if (self.scheduler is not None or tier != "standard"
+                or weight != 1.0):
+            self._ensure_scheduler()
+            self._sched_register(entry)
         return entry
+
+    def add_fused_group(self, group_name: str, members, *,
+                        checkpoints: Optional[Dict[str, Any]] = None,
+                        batch_limit: int = 32, queue_limit: int = 256,
+                        batch_timeout_ms: float = 2.0,
+                        breaker_threshold: int = 5,
+                        breaker_reset_s: float = 30.0,
+                        canary_max_drift: Optional[float] = None,
+                        tier: str = "standard", weight: float = 1.0):
+        """Register N same-input-geometry models as ONE fused pool
+        entry group (docs/serving.md §multi-model): their graphs merge
+        into a single channel-concatenated forward
+        (nn/graph/fusion.build_fused_serving_net) behind ONE shared
+        continuous-batching engine, each member's traffic rides the
+        shared batch, and each member's output columns are sliced back
+        under its own name — hot-swap, canary, checkpoints, and circuit
+        breakers stay PER MEMBER.
+
+        `members` is an ordered name → model mapping (or a list of
+        (name, model) pairs); `checkpoints` maps member names to their
+        CheckpointManagers / directories. The group schedules as one
+        WFQ unit under `group_name` at `tier`/`weight`.
+
+        Fallback rule: when the member set cannot merge (not graphs,
+        differing input geometry, uninitialized members), every member
+        is registered as an ordinary independent entry instead —
+        counted in `serving_fused_fallback_total{reason="ineligible"}`
+        — and the list of independent entries is returned. On success
+        the :class:`FusedModelGroup` is returned."""
+        from ..nn.graph.fusion import FusionIneligibleError
+        named = list(members.items()) if isinstance(members, dict) \
+            else list(members)
+        ckpts = checkpoints or {}
+        with self._lock:
+            for nm, _ in named:
+                if nm in self._entries:
+                    raise ValueError(f"model {nm!r} already registered")
+        try:
+            group = FusedModelGroup(
+                self, group_name, named, checkpoints=ckpts,
+                batch_limit=batch_limit, queue_limit=queue_limit,
+                batch_timeout_ms=batch_timeout_ms,
+                breaker_threshold=breaker_threshold,
+                breaker_reset_s=breaker_reset_s,
+                canary_max_drift=canary_max_drift,
+                tier=tier, weight=weight)
+        except FusionIneligibleError as e:
+            _fused_fallback_counter("ineligible", len(named))
+            entries = [self.add(nm, m, checkpoints=ckpts.get(nm),
+                                batch_limit=batch_limit,
+                                queue_limit=queue_limit,
+                                batch_timeout_ms=batch_timeout_ms,
+                                breaker_threshold=breaker_threshold,
+                                breaker_reset_s=breaker_reset_s,
+                                canary_max_drift=canary_max_drift,
+                                tier=tier, weight=weight)
+                       for nm, m in named]
+            for entry in entries:
+                entry.fused_fallback = str(e)
+            return entries
+        with self._lock:
+            for nm, _ in named:
+                if nm in self._entries:  # raced a concurrent add
+                    group.engine.shutdown()
+                    raise ValueError(f"model {nm!r} already registered")
+            for entry in group.member_entries():
+                self._entries[entry.name] = entry
+        if (self.scheduler is not None or tier != "standard"
+                or weight != 1.0):
+            self._ensure_scheduler()
+            self._sched_register(group.member_entries()[0])
+        return group
+
+    def eject_member(self, name: str) -> ModelEntry:
+        """Fall one member back to per-model dispatch (swap-state or
+        behavior divergence): the member leaves its fused group and gets
+        its own independent engine; the group rebuilds around the
+        remaining members, or dissolves entirely when fewer than two
+        remain. Counted in `serving_fused_fallback_total`."""
+        entry = self.get(name)
+        if entry.group is None:
+            raise ValueError(f"model {name!r} is not in a fused group")
+        return entry.group.eject(name)
+
+    def reconfigure(self, name: str, *,
+                    packed_admission: Optional[bool] = None,
+                    pack_bucket: Optional[int] = None,
+                    tier: Optional[str] = None,
+                    weight: Optional[float] = None) -> Dict[str, Any]:
+        """Live per-entry reconfiguration (the gateway's POST /config
+        surface). Tier/weight changes re-rank the entry in the shared
+        scheduler (creating it on first use); packed-admission changes
+        rebuild the entry's engine with the new admission mode — the
+        old engine drains its queue, the new one is warmed to the old
+        bucket set first, and no queued request is dropped. Fused-group
+        members cannot be reconfigured in place (eject_member first)."""
+        entry = self.get(name)
+        if entry.group is not None:
+            raise ValueError(
+                f"model {name!r} is a member of fused group "
+                f"{entry.group.name!r}; eject_member() it before "
+                "reconfiguring")
+        changed: List[str] = []
+        if tier is not None or weight is not None:
+            if tier is not None:
+                if tier not in TIER_VALUES:
+                    raise ValueError(f"unknown tier {tier!r}; one of "
+                                     f"{tuple(TIER_VALUES)}")
+                entry.tier = tier
+                changed.append("tier")
+            if weight is not None:
+                if float(weight) <= 0:
+                    raise ValueError("weight must be > 0")
+                entry.weight = float(weight)
+                changed.append("weight")
+            self._ensure_scheduler()
+            self._sched_register(entry)
+        if packed_admission is not None or pack_bucket is not None:
+            old = entry.engine
+            packed = old.packed_admission if packed_admission is None \
+                else bool(packed_admission)
+            bucket = old.pack_bucket if pack_bucket is None \
+                else int(pack_bucket)
+            engine = ParallelInference(
+                entry.model, inference_mode=old.inference_mode,
+                batch_limit=old.batch_limit,
+                batch_timeout_ms=old.batch_timeout_ms,
+                queue_limit=old._queue.maxsize,
+                check_finite=old.check_finite,
+                packed_admission=packed, pack_bucket=bucket)
+            if old.warmed_buckets:
+                # Warm the replacement BEFORE it takes traffic so the
+                # flip costs no steady-state compiles (shared model =
+                # shared compile cache; only a new packed signature
+                # compiles, once, here).
+                engine.warmup(max_bucket=max(old.warmed_buckets))
+            entry.engine = engine
+            self._wire_hooks(entry)
+            self._sched_register(entry)
+            old.shutdown()
+            changed.append("packed_admission")
+        out = entry.describe()
+        out["reconfigured"] = changed
+        return out
 
     def get(self, name: str) -> ModelEntry:
         with self._lock:
@@ -261,7 +500,12 @@ class ModelPool:
 
     def remove(self, name: str) -> None:
         with self._lock:
-            entry = self._entries.pop(name, None)
+            entry = self._entries.get(name)
+            if entry is not None and entry.group is not None:
+                raise ValueError(
+                    f"model {name!r} is a member of fused group "
+                    f"{entry.group.name!r}; eject_member() it first")
+            self._entries.pop(name, None)
         if entry is not None:
             entry.engine.shutdown()
 
@@ -295,6 +539,12 @@ class ModelPool:
         "iteration"}; raises :class:`SwapError` when the gate or the
         warm fails (old params keep serving either way)."""
         entry = self.get(name)
+        if entry.group is not None:
+            # Fused-group member: the group owns the swap protocol (the
+            # fused trees must be rebuilt under the SHARED engine's
+            # pause). /swap stays per-member for callers either way.
+            return entry.group.swap_member(name, manager=manager,
+                                           time_steps=time_steps)
         mgr = manager or entry.checkpoints
         if mgr is None:
             _swap_counter(name, "failed")
@@ -415,3 +665,337 @@ class ModelPool:
 
     def __exit__(self, *exc):
         self.shutdown()
+
+
+class FusedModelGroup:
+    """N co-resident same-input-geometry models behind ONE forward.
+
+    The members' graphs are merged (nn/graph/fusion.merge_serving_conf)
+    and sibling-fused into a single channel-concatenated network: one
+    shared continuous-batching engine coalesces EVERY member's traffic
+    into the same batch, runs one dispatch, and each request's transform
+    slices its member's columns back out. One dispatch + one coalescing
+    window serving N models is the multi-model throughput win
+    (docs/serving.md §multi-model measures it).
+
+    Per-member semantics are preserved:
+
+    - **Breakers** — each member keeps its own CircuitBreaker. Success
+      is recorded by the member's column transform on its normal path;
+      failures are attributed through ``err.request_tags`` (only the
+      members whose requests rode the failed forward are charged), and
+      a member whose columns turn non-finite trips ONLY its own breaker
+      (the fused engine runs check_finite=False; finiteness is judged
+      per member column slice).
+    - **Hot-swap / canary / checkpoints** — :meth:`swap_member` runs the
+      full pool swap protocol for one member: decode against the SOLO
+      member trees (the source of truth), rebuild the fused trees under
+      the shared engine's pause, warm through the existing fused
+      executables (zero compiles), and gate on a member-column golden
+      canary with rollback of both solo and fused trees.
+    - **Fallback** — an ineligible member set never reaches this class
+      (ModelPool.add_fused_group registers independents instead), and
+      :meth:`eject` returns one divergent member to per-model dispatch
+      at runtime, rebuilding or dissolving the group.
+    """
+
+    def __init__(self, pool: ModelPool, name: str, named_members,
+                 *, checkpoints: Dict[str, Any], batch_limit: int,
+                 queue_limit: int, batch_timeout_ms: float,
+                 breaker_threshold: int, breaker_reset_s: float,
+                 canary_max_drift: Optional[float],
+                 tier: str, weight: float):
+        from ..nn.graph import fusion
+        if tier not in TIER_VALUES:
+            raise ValueError(f"unknown tier {tier!r}; one of "
+                             f"{tuple(TIER_VALUES)}")
+        self.pool = pool
+        self.name = name
+        self.tier = tier
+        self.weight = float(weight)
+        self._engine_kw = dict(batch_limit=batch_limit,
+                               queue_limit=queue_limit,
+                               batch_timeout_ms=batch_timeout_ms)
+        self._breaker_kw = dict(failure_threshold=breaker_threshold,
+                                reset_timeout_s=breaker_reset_s)
+        self.members = [nm for nm, _ in named_members]
+        self._models = {nm: m for nm, m in named_members}
+        # Raises FusionIneligibleError on divergent members — the
+        # caller's fallback-to-independent seam.
+        self.fused_net, self.fusion_groups, self.col_slices = \
+            fusion.build_fused_serving_net(named_members)
+        # One engine for the whole group. check_finite stays OFF at the
+        # engine level: a NaN in one member's columns must trip that
+        # member's breaker only, so finiteness is judged per slice in
+        # the member transforms below.
+        self.engine = ParallelInference(self.fused_net,
+                                        check_finite=False,
+                                        **self._engine_kw)
+        self._entries: Dict[str, ModelEntry] = {}
+        for nm, model in named_members:
+            ck = checkpoints.get(nm)
+            if isinstance(ck, (str, os.PathLike)):
+                from ..optimize.resilience import CheckpointManager
+                ck = CheckpointManager(ck)
+            entry = ModelEntry(
+                nm, model, self.engine, ck,
+                breaker=CircuitBreaker(nm, **self._breaker_kw),
+                canary_max_drift=canary_max_drift,
+                tier=tier, weight=weight)
+            entry.group = self
+            entry.transform = self._member_transform(nm, entry.breaker)
+            self._entries[nm] = entry
+        self._wire_group_hooks()
+
+    # ------------------------------------------------------------ plumbing
+    def member_entries(self) -> List[ModelEntry]:
+        return [self._entries[nm] for nm in self.members]
+
+    def named_members(self):
+        return [(nm, self._models[nm]) for nm in self.members]
+
+    def _member_transform(self, name: str, breaker: CircuitBreaker):
+        """Column view for one member: slice its columns out of the
+        fused output, fail THIS request (and trip THIS breaker, via the
+        tagged error path) when they are non-finite, record breaker
+        success otherwise."""
+        def _t(rows, _name=name, _breaker=breaker):
+            off, width = self.col_slices[_name]
+            cols = np.asarray(rows)[..., off:off + width]
+            if not np.isfinite(cols).all():
+                raise NonFiniteOutputError(
+                    f"fused member {_name!r} produced non-finite output "
+                    "columns")
+            _breaker.record_success()
+            return cols
+        return _t
+
+    def _wire_group_hooks(self) -> None:
+        """Shared-engine telemetry: batch stats label the GROUP (one
+        forward serves many members); sheds label the member that owned
+        the request; failures are attributed to member breakers through
+        the error's request_tags."""
+        shed_c, fwd_c, rows_c, fill_h, fail_c = \
+            self.pool._serving_families()
+
+        def _on_shed(req, reason, _g=self.name):
+            shed_c.labels(model=req.tag or _g, reason=reason).inc()
+
+        def _on_batch(reqs, rows, bucket, dur_s, _g=self.name):
+            fwd_c.labels(model=_g).inc()
+            rows_c.labels(model=_g).inc(rows)
+            fill_h.labels(model=_g).observe(rows)
+            for r in reqs:
+                e = self._entries.get(r.tag)
+                if e is not None and e.golden_batch is None:
+                    # Retain per-member canary input from real traffic.
+                    e.golden_batch = np.asarray(r.x[:4]).copy()
+
+        def _on_batch_error(exc, n_requests, _g=self.name):
+            fail_c.labels(model=_g).inc()
+            trip = isinstance(exc, NonFiniteOutputError)
+            tags = getattr(exc, "request_tags", None) or []
+            charged = set()
+            for tag in tags:
+                e = self._entries.get(tag)
+                if e is not None and tag not in charged:
+                    charged.add(tag)
+                    e.breaker.record_failure(trip=trip)
+
+        self.engine.on_shed = _on_shed
+        self.engine.on_batch = _on_batch
+        self.engine.on_batch_error = _on_batch_error
+
+    # ---------------------------------------------------------------- swap
+    def swap_member(self, name: str, *, manager=None,
+                    time_steps: Optional[int] = None) -> Dict[str, Any]:
+        """Per-member checkpoint hot-swap inside the fused group: the
+        ModelPool.swap protocol with the fused forward as the execution
+        substrate. The member's SOLO model stays the decode template and
+        source of truth; under the shared engine's pause the solo trees
+        mutate, the fused trees rebuild from ALL members' current trees
+        (concat — no compile), the warmed buckets re-verify through the
+        existing fused executables, and a member-column canary gates
+        promotion. Rollback restores both solo and fused trees, so
+        neither this member nor its groupmates ever see half-swapped
+        params."""
+        entry = self._entries.get(name)
+        if entry is None:
+            raise KeyError(f"no member {name!r} in fused group "
+                           f"{self.name!r}")
+        mgr = manager or entry.checkpoints
+        if mgr is None:
+            _swap_counter(name, "failed")
+            raise SwapError(f"model {name!r} has no CheckpointManager "
+                            "attached — nothing to swap from")
+        rec = mgr.latest_valid()
+        if rec is None:
+            _swap_counter(name, "failed")
+            raise SwapError(
+                f"no valid checkpoint in {mgr.directory!r} — manifest "
+                "empty or every entry torn/corrupt")
+        if rec.get("file") and rec.get("file") == entry.version.get("file"):
+            _swap_counter(name, "noop")
+            return {"swapped": False, "model": name, "file": rec["file"],
+                    "iteration": rec.get("iteration", 0),
+                    "reason": "already serving this checkpoint"}
+        from ..nn.graph.fusion import fused_trees_from_members
+        path = os.path.join(mgr.directory, rec["file"])
+        model = entry.model  # the member's SOLO network
+        fused = self.fused_net
+        with tracing.span("serve/swap", model=name, group=self.name,
+                          file=rec.get("file")):
+            try:
+                faults.fire("serve.decode")
+                meta = validate_checkpoint(path)
+                with zipfile.ZipFile(path, "r") as zf:
+                    new_params = _npz_bytes_to_tree(
+                        _read_entry(zf, path, PARAMS_ENTRY),
+                        model.params_tree)
+                    new_state = _npz_bytes_to_tree(
+                        _read_entry(zf, path, STATE_ENTRY),
+                        model.state_tree)
+            except (CheckpointCorruptError, ValueError,
+                    faults.FaultInjected) as e:
+                _swap_counter(name, "failed")
+                raise SwapError(
+                    f"checkpoint {rec.get('file')!r} cannot serve model "
+                    f"{name!r}: {e}") from e
+            old_solo = (model.params_tree, model.state_tree,
+                        int(model.iteration), int(model.epoch))
+            old_fused = (fused.params_tree, fused.state_tree)
+            buckets = list(self.engine.warmed_buckets) or [1]
+            golden = entry.golden_batch
+            off, width = self.col_slices[name]
+            with self.engine.paused():
+                old_cols = None
+                if golden is not None:
+                    try:
+                        old_cols = _golden_forward(
+                            fused, golden)[..., off:off + width]
+                    except Exception:
+                        old_cols = None  # degrade to finiteness check
+                model.params_tree = new_params
+                model.state_tree = new_state
+                model.iteration = int(meta.get("iteration", old_solo[2]))
+                model.epoch = int(meta.get("epoch", old_solo[3]))
+                try:
+                    # Rebuild the fused trees from every member's
+                    # CURRENT solo trees — pure concat, the fused
+                    # executables keep their shapes.
+                    fused.params_tree, fused.state_tree = \
+                        fused_trees_from_members(self.fusion_groups,
+                                                 self.named_members())
+                    for b in buckets:
+                        faults.fire("swap.warm")
+                        fused.warmup(b, time_steps=time_steps)
+                    if golden is not None:
+                        new_cols = _golden_forward(
+                            fused, golden)[..., off:off + width]
+                        if not np.isfinite(new_cols).all():
+                            raise _CanaryRejected(
+                                "non-finite member columns on the "
+                                "golden batch")
+                        drift_cap = entry.canary_max_drift
+                        if (drift_cap is not None and old_cols is not None
+                                and np.isfinite(old_cols).all()):
+                            drift = float(np.max(np.abs(
+                                new_cols - old_cols))) \
+                                if new_cols.size else 0.0
+                            if drift > drift_cap:
+                                raise _CanaryRejected(
+                                    f"member-column drift {drift:.6g} "
+                                    "exceeds canary_max_drift "
+                                    f"{drift_cap}")
+                except Exception as e:
+                    (model.params_tree, model.state_tree,
+                     model.iteration, model.epoch) = old_solo
+                    fused.params_tree, fused.state_tree = old_fused
+                    canary = isinstance(e, _CanaryRejected)
+                    _swap_counter(
+                        name, "canary_rejected" if canary else "failed")
+                    what = ("canary gate rejected"
+                            if canary else "warm forward failed on")
+                    raise SwapError(
+                        f"{what} {rec.get('file')!r}; rolled back to "
+                        f"previous params: {e}") from e
+        entry.version = dict(rec)
+        entry.swaps += 1
+        _swap_counter(name, "ok")
+        return {"swapped": True, "model": name, "file": rec.get("file"),
+                "iteration": rec.get("iteration", 0)}
+
+    # --------------------------------------------------------------- eject
+    def eject(self, name: str) -> ModelEntry:
+        """Return one member to independent per-model dispatch and
+        rebuild the group around the remaining members (dissolving it
+        entirely below two). The ejected member keeps its breaker,
+        checkpoints, canary state, and pool name; it gets a fresh
+        engine warmed to the group's bucket set. Queued requests on the
+        old shared engine are served by its shutdown drain."""
+        if name not in self._entries:
+            raise KeyError(f"no member {name!r} in fused group "
+                           f"{self.name!r}")
+        pool = self.pool
+        old_engine = self.engine
+        warm_top = max(old_engine.warmed_buckets) \
+            if old_engine.warmed_buckets else None
+
+        def _independent(entry: ModelEntry) -> None:
+            entry.group = None
+            entry.transform = None
+            entry.engine = ParallelInference(
+                entry.model, check_finite=True, **self._engine_kw)
+            if warm_top:
+                entry.engine.warmup(max_bucket=warm_top)
+            pool._wire_hooks(entry)
+            pool._sched_register(entry)
+
+        ejected = self._entries.pop(name)
+        self.members.remove(name)
+        self._models.pop(name)
+        _independent(ejected)
+        _fused_fallback_counter("ejected")
+        if len(self.members) >= 2:
+            # Rebuild the fused substrate around the survivors: new
+            # merged net, new engine (the old executables baked the
+            # departed member's columns in).
+            from ..nn.graph import fusion
+            self.fused_net, self.fusion_groups, self.col_slices = \
+                fusion.build_fused_serving_net(self.named_members())
+            self.engine = ParallelInference(self.fused_net,
+                                            check_finite=False,
+                                            **self._engine_kw)
+            if warm_top:
+                self.engine.warmup(max_bucket=warm_top)
+            for nm in self.members:
+                e = self._entries[nm]
+                e.engine = self.engine
+                e.transform = self._member_transform(nm, e.breaker)
+                pool._sched_register(e)
+            self._wire_group_hooks()
+        else:
+            # One member left: a fused group of one is just overhead.
+            for nm in list(self.members):
+                e = self._entries.pop(nm)
+                self.members.remove(nm)
+                self._models.pop(nm)
+                _independent(e)
+                _fused_fallback_counter("dissolved")
+            if pool.scheduler is not None:
+                pool.scheduler.unregister(self.name)
+        old_engine.shutdown()
+        return ejected
+
+    def describe(self) -> Dict[str, Any]:
+        return {
+            "group": self.name,
+            "members": list(self.members),
+            "col_slices": {nm: list(self.col_slices[nm])
+                           for nm in self.members},
+            "tier": self.tier,
+            "weight": self.weight,
+            "total_forwards": self.engine.total_forwards,
+            "queue_depth": self.engine.queue_depth(),
+            "fused_nodes": [g.fused_name for g in self.fusion_groups],
+        }

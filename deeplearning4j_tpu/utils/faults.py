@@ -57,6 +57,10 @@ Points used by the serving stack (docs/serving.md):
     serve.pack         packed-admission assembly/unpack of a segment-
                        masked row (fires twice per packed forward:
                        before the pack and before the unpack)
+    serve.schedule     entry to the device-scheduler slot, before the
+                       waiter is enqueued — armed errors surface as
+                       typed request failures without ever parking a
+                       thread on the scheduler condition
     swap.warm          each per-bucket warm forward inside the
                        pause-assign-warm swap window (fires the rollback
                        path when armed)

@@ -68,6 +68,14 @@ JAX_PLATFORMS=cpu python tests/smoke_serving.py
 # after warmup, zero hung requests (hard in-process alarm).
 JAX_PLATFORMS=cpu python tests/smoke_chaos_serving.py
 
+# Multi-model serving smoke (docs/serving.md §multi-model): three
+# same-geometry heads fused into ONE channel-concatenated forward plus
+# a batch-tier independent model, concurrent per-member HTTP traffic
+# through a live PER-MEMBER hot-swap — all member requests 200, zero
+# compiles after warmup, batch tier only ever sheds TYPED, starvation
+# counter frozen without queued work. Hard signal.alarm guard.
+JAX_PLATFORMS=cpu python tests/smoke_multimodel.py
+
 # Cluster-health smoke (docs/robustness.md §cluster-health): fake-clock
 # watchdog transitions (PeerLost/Desync), typed barrier timeout, and a
 # real SIGTERM'd child writing a grace checkpoint then resuming

@@ -1,0 +1,229 @@
+"""Multi-model serving smoke (docs/serving.md §multi-model, ISSUE 14):
+the fused-group + WFQ acceptance check, end to end over real HTTP.
+
+Builds a gateway serving THREE same-geometry heads as one
+FusedModelGroup (tier critical) plus one independent batch-tier model,
+warmup()s every pow2 bucket, then — under a CompilationTracker — drives
+concurrent per-member HTTP /predict traffic through a live PER-MEMBER
+checkpoint hot-swap. Asserts:
+
+* every member request returns 200 (zero drops/errors across the
+  member swap; batch-tier requests may only ever shed TYPED),
+* the member swap reports swapped=True, post-swap predictions for that
+  member are the new checkpoint's, and its groupmates' outputs are
+  untouched,
+* ZERO XLA compile events after warmup (fused steady state + member
+  swap both ride the shared AOT executables),
+* starvation is bounded: ``serving_starvation_total`` never moves
+  without queued work (idle scrape delta == 0),
+* the multi-model metric families are on the scrape surface.
+
+A hard wall-clock alarm guards the whole run: a wedged scheduler slot
+or hung request fails the smoke instead of wedging CI.
+
+Run by runtests.sh as a separate step (no test_ prefix on purpose).
+Usage: JAX_PLATFORMS=cpu python tests/smoke_multimodel.py
+"""
+import json
+import os
+import signal
+import sys
+import tempfile
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deeplearning4j_tpu import (Adam, DenseLayer, InputType,  # noqa: E402
+                                MultiLayerNetwork, NeuralNetConfiguration,
+                                OutputLayer, WeightInit)
+from deeplearning4j_tpu.nn.graph.graph import ComputationGraph  # noqa: E402
+from deeplearning4j_tpu.optimize.metrics import registry  # noqa: E402
+from deeplearning4j_tpu.optimize.resilience import CheckpointManager  # noqa: E402
+from deeplearning4j_tpu.optimize.telemetry import CompilationTracker  # noqa: E402
+from deeplearning4j_tpu.serving import FusedModelGroup, ServingGateway  # noqa: E402
+
+HARD_TIMEOUT_S = 240
+MEMBERS = ("a", "b", "c")
+REQUIRED_FAMILIES = (
+    "serving_sched_dispatch_total", "serving_tier_slo_ms",
+    "serving_latency_ms_bucket", "serving_requests_total",
+)
+
+
+def _alarm(_sig, _frm):
+    print("SMOKE FAIL: hard wall-clock alarm fired — a request or the "
+          "scheduler slot is wedged", file=sys.stderr)
+    os._exit(2)
+
+
+def graph_net(seed):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(Adam(learning_rate=0.05))
+            .weight_init(WeightInit.XAVIER)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("dense", DenseLayer(n_out=16, activation="tanh"),
+                       "in")
+            .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                          loss="mcxent"), "dense")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(4))
+            .build())
+    return ComputationGraph(conf).init()
+
+
+def make_mlp(seed=42):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(Adam(learning_rate=0.05))
+            .weight_init(WeightInit.XAVIER).list()
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def post(url, payload):
+    req = urllib.request.Request(
+        url, json.dumps(payload).encode(),
+        {"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def starvation_total():
+    return registry().counter("serving_starvation_total", "").total()
+
+
+def main() -> int:
+    signal.signal(signal.SIGALRM, _alarm)
+    signal.alarm(HARD_TIMEOUT_S)
+    failures = []
+    members = [(nm, graph_net(seed))
+               for nm, seed in zip(MEMBERS, (1, 2, 3))]
+    donor = graph_net(88)
+    probe = np.random.default_rng(99).standard_normal(
+        (2, 4)).astype(np.float32)
+    solo = {nm: np.asarray(net.output(probe)) for nm, net in members}
+    want_b = np.asarray(donor.output(probe))
+
+    with tempfile.TemporaryDirectory(prefix="dl4jtpu_mm_smoke_") as d:
+        mgr = CheckpointManager(d)
+        mgr.save(donor)
+
+        gw = ServingGateway()
+        grp = gw.add_fused_group("trio", members, batch_limit=8,
+                                 checkpoints={"b": mgr},
+                                 tier="critical", weight=2.0)
+        if not isinstance(grp, FusedModelGroup):
+            print("SMOKE FAIL: fusion fell back to independent dispatch "
+                  "for same-geometry members", file=sys.stderr)
+            return 1
+        gw.add_model("low", make_mlp(9), tier="batch", batch_limit=8)
+        gw.warmup()  # AOT: every pow2 bucket of both engines
+
+        statuses, errors = [], []
+        stop = threading.Event()
+
+        def client(i):
+            nm = MEMBERS[i % len(MEMBERS)] if i % 4 else "low"
+            x = np.random.default_rng(i).standard_normal(
+                (1 + (i % 5), 4)).astype(np.float32)
+            try:
+                while not stop.is_set():
+                    code, body = post(gw.url + "/predict",
+                                      {"model": nm,
+                                       "features": x.tolist()})
+                    statuses.append((nm, code, body.get("status")))
+            except Exception as e:
+                errors.append(e)
+
+        with gw, CompilationTracker() as trk:
+            ts = [threading.Thread(target=client, args=(i,))
+                  for i in range(8)]
+            for t in ts:
+                t.start()
+            # live PER-MEMBER hot-swap while every member takes traffic
+            code, swap = post(gw.url + "/swap", {"model": "b"})
+            if code != 200 or swap.get("swapped") is not True:
+                failures.append(f"member swap failed: {code} {swap}")
+            stop.set()
+            for t in ts:
+                t.join(timeout=60)
+
+            # post-swap: b serves the donor, a and c are untouched
+            for nm, want in (("a", solo["a"]), ("b", want_b),
+                             ("c", solo["c"])):
+                code, body = post(gw.url + "/predict",
+                                  {"model": nm,
+                                   "features": probe.tolist()})
+                got = np.asarray(body.get("predictions"), np.float32)
+                if code != 200 or not np.allclose(got, want, rtol=0,
+                                                  atol=1e-6):
+                    failures.append(
+                        f"post-swap member {nm!r} wrong (code={code})")
+
+            # bounded starvation: an idle scrape window moves nothing
+            s0 = starvation_total()
+            for _ in range(3):
+                post(gw.url + "/predict",
+                     {"model": "a", "features": probe.tolist()})
+            if starvation_total() != s0:
+                failures.append(
+                    "serving_starvation_total grew without queued work")
+
+            with urllib.request.urlopen(gw.url + "/metrics") as r:
+                metrics_text = r.read().decode()
+            code, models = 200, json.loads(urllib.request.urlopen(
+                gw.url + "/models").read())
+        gw.pool.shutdown()
+
+    if errors:
+        failures.append(f"{len(errors)} client(s) errored: {errors[:3]}")
+    member_bad = [s for s in statuses
+                  if s[0] in MEMBERS and (s[1], s[2]) != (200, "ok")]
+    if member_bad:
+        failures.append(f"{len(member_bad)} fused-member requests not "
+                        f"200/ok across the swap: {member_bad[:5]}")
+    low = [s for s in statuses if s[0] == "low"]
+    low_bad = [s for s in low
+               if (s[1], s[2]) not in ((200, "ok"), (503, "shed"))]
+    if low_bad:
+        failures.append(f"{len(low_bad)} batch-tier requests neither ok "
+                        f"nor TYPED shed: {low_bad[:5]}")
+    if len(statuses) < 20:
+        failures.append(f"only {len(statuses)} requests completed")
+    if trk.count != 0:
+        failures.append(f"{trk.count} XLA compile(s) after warmup — "
+                        "fused steady state must compile nothing")
+    fused = [m for m in models["models"] if m.get("fused_group")]
+    if len(fused) != len(MEMBERS):
+        failures.append(f"/models lists {len(fused)} fused members, "
+                        f"wanted {len(MEMBERS)}")
+    for fam in REQUIRED_FAMILIES:
+        if fam not in metrics_text:
+            failures.append(f"metric family {fam} missing from /metrics")
+
+    signal.alarm(0)
+    if failures:
+        for f in failures:
+            print(f"SMOKE FAIL: {f}", file=sys.stderr)
+        return 1
+    shed = len([s for s in low if s[1] == 503])
+    print(f"multimodel smoke OK: {len(statuses)} requests across 3 fused "
+          f"members + 1 batch-tier model through a live member hot-swap, "
+          f"0 compiles after warmup, {shed} typed batch-tier sheds, "
+          f"starvation bounded, all {len(REQUIRED_FAMILIES)} families "
+          "scraped")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,518 @@
+"""Multi-model serving at scale (ISSUE 14): priority-tier WFQ
+scheduling + fused cross-model batching (docs/serving.md §multi-model).
+
+Covers the tentpole legs: deterministic weighted-deficit arbitration
+(tier precedence, in-tier WFQ dispatch ratios, starvation accounting
+that only moves when queued work is passed over), admission-side tier
+shedding with a typed 503 while higher tiers keep completing, the
+``serve.schedule`` chaos seam (typed errors, never hangs), the
+FusedModelGroup (per-member output parity vs the solo nets, per-member
+breaker isolation under a poisoned member, geometry-mismatch fallback
+to independent dispatch, per-member checkpoint hot-swap), the POST
+/config live-reconfigure surface, and the default-path regression
+guarantee (a pool that never expresses a priority never constructs a
+scheduler).
+
+Device work per test is deliberately tiny (stub models or shared
+4->16->3 heads on CPU); the eject/rebuild path is `slow`.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (Adam, DenseLayer, InputType,
+                                NeuralNetConfiguration, OutputLayer,
+                                WeightInit)
+from deeplearning4j_tpu.nn.graph.graph import ComputationGraph
+from deeplearning4j_tpu.optimize.metrics import registry
+from deeplearning4j_tpu.optimize.resilience import CheckpointManager
+from deeplearning4j_tpu.parallel.inference import (BatchExecutionError,
+                                                   NonFiniteOutputError)
+from deeplearning4j_tpu.serving import (BreakerOpenError, FusedModelGroup,
+                                        ModelEntry, ServingGateway,
+                                        SwapError, TierShedError)
+from deeplearning4j_tpu.serving.scheduler import (DEFAULT_TIER_SLO_MS,
+                                                  DeviceScheduler)
+from deeplearning4j_tpu.utils import faults
+
+from test_serving_gateway import _StubModel, make_net, post_json, rand_x
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def graph_net(seed, n_in=4):
+    """One single-input single-output head — the fusable member shape."""
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(Adam(learning_rate=0.05))
+            .weight_init(WeightInit.XAVIER)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("dense", DenseLayer(n_out=16, activation="tanh"),
+                       "in")
+            .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                          loss="mcxent"), "dense")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(n_in))
+            .build())
+    return ComputationGraph(conf).init()
+
+
+def trio():
+    return [("a", graph_net(1)), ("b", graph_net(2)), ("c", graph_net(3))]
+
+
+# ---------------------------------------------------------------------------
+# Tentpole: DeviceScheduler arbitration (deterministic, no threads)
+# ---------------------------------------------------------------------------
+class TestSchedulerArbitration:
+    def test_tier_precedence_beats_deficit(self):
+        sch = DeviceScheduler()
+        sch.register("hi", tier="critical", weight=1.0)
+        sch.register("lo", tier="batch", weight=100.0)
+        for _ in range(8):
+            assert sch._select(["lo", "hi"]) == "hi"
+        d = sch.describe()
+        assert d["hi"]["dispatches"] == 8
+        assert d["lo"]["dispatches"] == 0
+
+    def test_wfq_weights_set_in_tier_dispatch_ratio(self):
+        sch = DeviceScheduler()
+        sch.register("heavy", tier="standard", weight=3.0)
+        sch.register("light", tier="standard", weight=1.0)
+        wins = [sch._select(["heavy", "light"]) for _ in range(80)]
+        heavy = wins.count("heavy")
+        # weighted deficit round robin converges on the 3:1 share
+        assert 55 <= heavy <= 65, f"heavy won {heavy}/80, wanted ~60"
+        assert wins.count("light") == 80 - heavy
+
+    def test_starvation_fires_only_past_budget_and_only_when_waiting(self):
+        sch = DeviceScheduler(starvation_budget=2)
+        sch.register("crit", tier="critical")
+        sch.register("bat", tier="batch")
+        for _ in range(7):
+            assert sch._select(["crit", "bat"]) == "crit"
+        d = sch.describe()
+        # passed over 7x with budget 2 -> the counter fired at 3 and 6
+        assert d["bat"]["starvations"] == 2
+        assert d["crit"]["starvations"] == 0
+        # no queued work for bat -> the counter must never move again
+        for _ in range(10):
+            sch._select(["crit"])
+        assert sch.describe()["bat"]["starvations"] == 2
+
+    def test_registration_validates_and_survives_reconfigure(self):
+        sch = DeviceScheduler()
+        with pytest.raises(ValueError, match="tier"):
+            sch.register("x", tier="vip")
+        with pytest.raises(ValueError, match="weight"):
+            sch.register("x", tier="batch", weight=0.0)
+        sch.register("x", tier="batch", weight=2.0)
+        sch._select(["x"])
+        sch.register("x", tier="critical", weight=5.0)  # reconfigure
+        assert sch.describe()["x"]["dispatches"] == 1  # accounting kept
+        sch.unregister("x")
+        assert "x" not in sch.names()
+
+    def test_should_shed_tier_rule(self):
+        sch = DeviceScheduler(shed_depth=4)
+        sch.register("hi", tier="critical", depth_fn=lambda: 4)
+        sch.register("lo", tier="batch", depth_fn=lambda: 99)
+        assert sch.should_shed("lo") == "tier_shed"
+        # nothing outranks the top tier -> it is never tier-shed
+        assert sch.should_shed("hi") is None
+        # unregistered names are never shed
+        assert sch.should_shed("ghost") is None
+
+    def test_broken_depth_gauge_never_sheds(self):
+        def boom():
+            raise RuntimeError("gauge down")
+        sch = DeviceScheduler(shed_depth=1)
+        sch.register("hi", tier="critical", depth_fn=boom)
+        sch.register("lo", tier="batch")
+        assert sch.should_shed("lo") is None
+
+    def test_slo_gauges_exported(self):
+        DeviceScheduler(tier_slo_ms={"critical": 25.0})
+        g = registry().gauge("serving_tier_slo_ms", "")
+        assert g.labels(tier="critical").value() == 25.0
+        assert g.labels(tier="batch").value() == \
+            DEFAULT_TIER_SLO_MS["batch"]
+
+    def test_slot_serializes_and_admits_unregistered(self):
+        sch = DeviceScheduler()
+        order = []
+        with sch.slot("anon"):  # unregistered: FIFO at standard tier
+            order.append("first")
+        with sch.slot("anon"):
+            order.append("second")
+        assert order == ["first", "second"]
+
+
+# ---------------------------------------------------------------------------
+# Tentpole: tier shedding + graceful degradation through the gateway
+# ---------------------------------------------------------------------------
+class TestTierShedding:
+    def _gateway(self, shed_depth=2):
+        gw = ServingGateway()
+        gw.pool.scheduler = DeviceScheduler(shed_depth=shed_depth)
+        gate = threading.Event()
+        gw.add_model("crit", _StubModel(gate=gate), tier="critical",
+                     weight=2.0, batch_limit=1, batch_timeout_ms=0.0,
+                     queue_limit=64, check_finite=False)
+        gw.add_model("low", _StubModel(), tier="batch",
+                     batch_limit=4, check_finite=False)
+        return gw, gate
+
+    def _saturate(self, gw, n=3):
+        """Wedge crit's engine and queue up n-1 more requests."""
+        entry = gw.pool.get("crit")
+        results, errs = [], []
+
+        def call(i):
+            try:
+                results.append(gw.predict("crit", rand_x(1, seed=i)))
+            except Exception as e:  # pragma: no cover - surfaced below
+                errs.append(e)
+
+        ts = [threading.Thread(target=call, args=(i,)) for i in range(n)]
+        for t in ts:
+            t.start()
+        deadline = time.monotonic() + 5
+        while entry.engine.queue_depth() < n - 1 \
+                and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert entry.engine.queue_depth() >= n - 1, "never saturated"
+        return ts, results, errs
+
+    def test_low_tier_sheds_typed_while_high_tier_completes(self):
+        gw, gate = self._gateway(shed_depth=2)
+        try:
+            ts, results, errs = self._saturate(gw, n=3)
+            # saturated critical backlog -> batch tier sheds typed, NOW
+            with pytest.raises(TierShedError):
+                gw.predict("low", rand_x(1))
+            shed = registry().counter("serving_shed_total", "").labels(
+                model="low", reason="tier_shed").value()
+            assert shed >= 1
+            # ...but the critical tier itself is never tier-shed
+            gate.set()
+            for t in ts:
+                t.join(timeout=10)
+            assert not errs, errs[:3]
+            assert len(results) == 3
+            # backlog drained -> the low tier is admitted again
+            out = gw.predict("low", rand_x(2))
+            assert out.shape == (2, 4)
+        finally:
+            gate.set()
+            gw.pool.shutdown()
+
+    def test_tier_shed_maps_to_http_503(self):
+        gw, gate = self._gateway(shed_depth=2)
+        try:
+            with gw:
+                ts, results, errs = self._saturate(gw, n=3)
+                code, body = post_json(
+                    gw.url + "/predict",
+                    {"model": "low", "features": rand_x(1).tolist()})
+                assert code == 503, (code, body)
+                assert body["status"] == "shed"
+                assert body["reason"] == "tier_shed"
+                gate.set()
+                for t in ts:
+                    t.join(timeout=10)
+                assert not errs and len(results) == 3
+        finally:
+            gate.set()
+            gw.pool.shutdown()
+
+    def test_tier_latency_and_dispatch_metrics(self):
+        gw = ServingGateway()
+        gw.add_model("m", _StubModel(), tier="critical",
+                     check_finite=False)
+        try:
+            for i in range(3):
+                gw.predict("m", rand_x(1, seed=i))
+            st = gw.stats()
+            assert st["tiers"]["critical"]["count"] == 3
+            text = registry().prometheus_text()
+            assert "serving_sched_dispatch_total" in text
+            assert "serving_tier_slo_ms" in text
+        finally:
+            gw.pool.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Satellite: serve.schedule chaos seam
+# ---------------------------------------------------------------------------
+class TestScheduleChaos:
+    def test_armed_schedule_fault_is_typed_and_server_survives(self):
+        gw = ServingGateway()
+        gw.add_model("m", _StubModel(), tier="critical",
+                     check_finite=False)
+        try:
+            with faults.injected("serve.schedule", "fail:1"):
+                with pytest.raises(BatchExecutionError):
+                    gw.predict("m", rand_x(1))
+            # the collector survived the armed fault: traffic resumes
+            out = gw.predict("m", rand_x(1, seed=1))
+            np.testing.assert_array_equal(out, rand_x(1, seed=1) * 2.0)
+            assert gw.pool.get("m").engine.total_batch_failures >= 1
+        finally:
+            gw.pool.shutdown()
+
+    def test_periodic_schedule_faults_never_hang_concurrent_clients(self):
+        gw = ServingGateway()
+        gw.add_model("m", _StubModel(), tier="standard", weight=2.0,
+                     batch_limit=2, check_finite=False)
+        outcomes = []
+
+        def client(i):
+            try:
+                gw.predict("m", rand_x(1, seed=i), deadline_ms=30_000)
+                outcomes.append("ok")
+            except (BatchExecutionError, faults.FaultInjected):
+                outcomes.append("typed")
+            except Exception as e:  # pragma: no cover
+                outcomes.append(repr(e))
+
+        try:
+            with faults.injected("serve.schedule", "fail:2/3"):
+                ts = [threading.Thread(target=client, args=(i,))
+                      for i in range(9)]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join(timeout=20)
+                assert not any(t.is_alive() for t in ts), "client hung"
+            assert len(outcomes) == 9
+            assert set(outcomes) <= {"ok", "typed"}, outcomes
+        finally:
+            gw.pool.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Tentpole: FusedModelGroup — cross-model fused batching
+# ---------------------------------------------------------------------------
+class TestFusedGroup:
+    def test_member_outputs_match_solo_nets(self):
+        members = trio()
+        x = rand_x(2, seed=7)
+        solo = {nm: np.asarray(net.output(x)) for nm, net in members}
+        gw = ServingGateway()
+        grp = gw.add_fused_group("grp", members, batch_limit=8,
+                                 tier="critical", weight=2.0)
+        try:
+            assert isinstance(grp, FusedModelGroup)
+            for nm, _ in members:
+                got = np.asarray(gw.predict(nm, x))
+                np.testing.assert_allclose(got, solo[nm], rtol=0,
+                                           atol=1e-6)
+            # one shared engine, scheduled as ONE unit under the group
+            engines = {id(gw.pool.get(nm).engine) for nm, _ in members}
+            assert len(engines) == 1
+            assert gw.pool.scheduler is not None
+            assert gw.pool.get("a").engine.sched_name == "grp"
+            desc = grp.describe()
+            assert desc["members"] == ["a", "b", "c"]
+            assert sum(w for _, w in desc["col_slices"].values()) == 9
+        finally:
+            gw.pool.shutdown()
+
+    def test_poisoned_member_trips_only_its_breaker(self):
+        import jax.numpy as jnp
+        members = trio()
+        x = rand_x(2, seed=9)
+        gw = ServingGateway()
+        grp = gw.add_fused_group("grp", members, batch_limit=8)
+        try:
+            gw.predict("b", x)  # healthy first: breaker sees a success
+            pt = grp.fused_net.params_tree
+            pt["b/out"] = {k: jnp.full_like(v, jnp.nan)
+                           for k, v in pt["b/out"].items()}
+            with pytest.raises(NonFiniteOutputError):
+                gw.predict("b", x)
+            assert gw.pool.get("b").breaker.describe()["state"] == "open"
+            with pytest.raises(BreakerOpenError):
+                gw.predict("b", x)
+            # groupmates ride the same fused forward, unharmed
+            for nm in ("a", "c"):
+                assert gw.pool.get(nm).breaker.describe()["state"] \
+                    == "closed"
+                out = np.asarray(gw.predict(nm, x))
+                assert np.isfinite(out).all()
+        finally:
+            gw.pool.shutdown()
+
+    def test_geometry_mismatch_falls_back_to_independent(self):
+        fb = registry().counter("serving_fused_fallback_total", "")
+        before = fb.labels(reason="ineligible").value()
+        members = [("wide", graph_net(5, n_in=6)),
+                   ("narrow", graph_net(6, n_in=4))]
+        gw = ServingGateway()
+        got = gw.add_fused_group("grp", members, batch_limit=4)
+        try:
+            assert isinstance(got, list)
+            assert all(isinstance(e, ModelEntry) for e in got)
+            assert fb.labels(reason="ineligible").value() \
+                == before + len(members)
+            for e in got:
+                assert e.group is None
+                assert e.fused_fallback
+            # both still serve, each on its own engine
+            out = gw.predict("wide", np.zeros((1, 6), np.float32))
+            assert out.shape == (1, 3)
+            out = gw.predict("narrow", np.zeros((1, 4), np.float32))
+            assert out.shape == (1, 3)
+        finally:
+            gw.pool.shutdown()
+
+    def test_member_hot_swap_updates_only_that_member(self, tmp_path):
+        members = trio()
+        donor = graph_net(88)
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        mgr.save(donor)
+        x = rand_x(2, seed=11)
+        want_b = np.asarray(donor.output(x))
+        gw = ServingGateway()
+        gw.add_fused_group("grp", members, batch_limit=4)
+        try:
+            ref_a = np.asarray(gw.predict("a", x))
+            res = gw.swap("b", manager=mgr)
+            assert res["swapped"] is True
+            np.testing.assert_allclose(np.asarray(gw.predict("b", x)),
+                                       want_b, rtol=0, atol=1e-6)
+            # groupmate a is untouched by b's swap
+            np.testing.assert_array_equal(np.asarray(gw.predict("a", x)),
+                                          ref_a)
+            assert gw.pool.get("b").swaps == 1
+            # idempotent per checkpoint, exactly like solo swaps
+            assert gw.swap("b", manager=mgr)["swapped"] is False
+        finally:
+            gw.pool.shutdown()
+
+    def test_member_swap_canary_rolls_back_solo_and_fused(self, tmp_path):
+        members = trio()
+        donor = graph_net(99)
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        mgr.save(donor)
+        x = rand_x(2, seed=13)
+        gw = ServingGateway()
+        gw.add_fused_group("grp", members, batch_limit=4,
+                           canary_max_drift=1e-12)
+        try:
+            ref_b = np.asarray(gw.predict("b", x))  # seeds golden batch
+            with pytest.raises(SwapError, match="canary"):
+                gw.swap("b", manager=mgr)
+            # rolled back: old params still serving, version unchanged
+            np.testing.assert_array_equal(np.asarray(gw.predict("b", x)),
+                                          ref_b)
+            assert gw.pool.get("b").swaps == 0
+        finally:
+            gw.pool.shutdown()
+
+    @pytest.mark.slow
+    def test_eject_member_keeps_everyone_serving(self):
+        members = trio()
+        x = rand_x(2, seed=17)
+        solo = {nm: np.asarray(net.output(x)) for nm, net in members}
+        gw = ServingGateway()
+        grp = gw.add_fused_group("grp", members, batch_limit=4)
+        try:
+            grp_engine = gw.pool.get("a").engine
+            out = gw.pool.eject_member("b")
+            assert out.group is None
+            # b now dispatches independently...
+            assert gw.pool.get("b").engine is not grp_engine
+            np.testing.assert_allclose(np.asarray(gw.predict("b", x)),
+                                       solo["b"], rtol=0, atol=1e-6)
+            # ...while a and c re-fused around the survivor set
+            assert gw.pool.get("a").engine is gw.pool.get("c").engine
+            for nm in ("a", "c"):
+                np.testing.assert_allclose(np.asarray(gw.predict(nm, x)),
+                                           solo[nm], rtol=0, atol=1e-6)
+            ej = registry().counter("serving_fused_fallback_total", "")
+            assert ej.labels(reason="ejected").value() >= 1
+        finally:
+            gw.pool.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Satellites: POST /config + the default-path regression guarantee
+# ---------------------------------------------------------------------------
+class TestConfigRoute:
+    def test_packed_and_tier_knobs_over_http(self):
+        gw = ServingGateway()
+        gw.add_model("m", _StubModel(), check_finite=False)
+        with gw:
+            old_engine = gw.pool.get("m").engine
+            code, body = post_json(gw.url + "/config",
+                                   {"model": "m",
+                                    "packed_admission": True,
+                                    "pack_bucket": 8})
+            assert code == 200, (code, body)
+            assert "packed_admission" in body["reconfigured"]
+            entry = gw.pool.get("m")
+            assert entry.engine is not old_engine
+            assert entry.engine.packed_admission
+            assert entry.engine.pack_bucket == 8
+            code, body = post_json(gw.url + "/config",
+                                   {"model": "m", "tier": "critical",
+                                    "weight": 3.0})
+            assert code == 200 and set(body["reconfigured"]) \
+                == {"tier", "weight"}
+            assert entry.tier == "critical"
+            assert gw.pool.scheduler.describe()["m"]["weight"] == 3.0
+
+    def test_config_error_statuses(self):
+        gw = ServingGateway()
+        gw.add_fused_group("grp", trio(), batch_limit=4)
+        with gw:
+            code, _ = post_json(gw.url + "/config", {"model": "m"})
+            assert code == 400  # no knobs
+            code, _ = post_json(gw.url + "/config",
+                                {"model": "ghost", "tier": "batch"})
+            assert code == 404
+            code, body = post_json(gw.url + "/config",
+                                   {"model": "a", "tier": "batch"})
+            assert code == 409  # fused member: eject first
+            assert "fused group" in body["error"]
+        gw.pool.shutdown()
+
+
+class TestDefaultPathRegression:
+    def test_default_add_never_constructs_a_scheduler(self):
+        gw = ServingGateway()
+        gw.add_model("m", make_net())
+        try:
+            assert gw.pool.scheduler is None
+            entry = gw.pool.get("m")
+            assert entry.engine.scheduler is None
+            assert entry.tier == "standard" and entry.weight == 1.0
+            out = gw.predict("m", rand_x(2))
+            assert out.shape == (2, 3)
+            assert "tiers" not in gw.stats()
+        finally:
+            gw.pool.shutdown()
+
+    def test_first_tiered_add_retro_registers_earlier_models(self):
+        gw = ServingGateway()
+        gw.add_model("plain", _StubModel(), check_finite=False)
+        assert gw.pool.scheduler is None
+        gw.add_model("vip", _StubModel(), tier="critical",
+                     check_finite=False)
+        try:
+            sch = gw.pool.scheduler
+            assert sch is not None
+            assert set(sch.names()) == {"plain", "vip"}
+            assert sch.describe()["plain"]["tier"] == "standard"
+            assert gw.pool.get("plain").engine.scheduler is sch
+        finally:
+            gw.pool.shutdown()

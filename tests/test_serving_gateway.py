@@ -83,8 +83,10 @@ class _StubModel:
     def __init__(self, block_s=0.0, gate=None):
         self.block_s = block_s
         self.gate = gate  # threading.Event the forward waits on
+        self.forward_entered = threading.Event()
 
     def output(self, x):
+        self.forward_entered.set()
         if self.gate is not None:
             self.gate.wait(timeout=10)
         if self.block_s:
@@ -151,14 +153,19 @@ class TestParallelInferenceServing:
                 target=lambda: results.append(pi.output(rand_x(1))))
             t.start()
             # wait until the collector picked up the first request and
-            # is blocked in the forward, then fill the 1-slot queue
+            # is blocked in the forward (a bare queue-depth poll races:
+            # on a loaded host it reads 0 before the request even
+            # enqueued), then fill the 1-slot queue
+            assert pi.model.forward_entered.wait(timeout=5)
             deadline = time.monotonic() + 5
             while pi.queue_depth() > 0 and time.monotonic() < deadline:
                 time.sleep(0.005)
             blocked = threading.Thread(
                 target=lambda: results.append(pi.output(rand_x(1))))
             blocked.start()
-            time.sleep(0.05)
+            deadline = time.monotonic() + 5
+            while pi.queue_depth() < 1 and time.monotonic() < deadline:
+                time.sleep(0.005)
             with pytest.raises(QueueFullError):
                 pi.output(rand_x(1))
         finally:
