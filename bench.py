@@ -1256,6 +1256,7 @@ def _register_metric_families():
     from deeplearning4j_tpu.optimize import resilience, scoreboard
     from deeplearning4j_tpu.parallel import cluster_health
     from deeplearning4j_tpu.serving import breaker as serving_breaker
+    from deeplearning4j_tpu.serving import flight_recorder
     from deeplearning4j_tpu.serving import model_pool as serving_pool
     from deeplearning4j_tpu.serving import scheduler as serving_scheduler
     # Recovery counters (rollbacks/retries — docs/robustness.md),
@@ -1269,6 +1270,7 @@ def _register_metric_families():
     serving_breaker.register_metrics()
     serving_scheduler.register_metrics()
     serving_pool.register_metrics()
+    flight_recorder.register_metrics()
     cluster_health.register_metrics()
     pooling_ops.register_metrics()
     graph_fusion.register_metrics()
@@ -1537,7 +1539,8 @@ def main():
     # comparison into the ledger row itself — `bench.py report` and the
     # regression sentinel see the ratio without re-parsing artifacts.
     ledger_extras = {"raw_times_s": med.get("raw_times_s", [])}
-    for k in ("fused_speedup", "independent_rps", "fused_group"):
+    for k in ("fused_speedup", "independent_rps", "fused_group",
+              "tier_latency_ms", "tier_sheds", "starvation_total"):
         if k in med:
             ledger_extras[k] = med[k]
     _append_ledger(scoreboard.make_row(

@@ -183,6 +183,7 @@ class Histogram(_Family):
         self._counts = [0] * (len(self.buckets) + 1)  # +inf tail
         self._sum = 0.0
         self._n = 0
+        self._exemplar: Optional[Tuple[str, float]] = None
 
     def labels(self, **labels) -> "Histogram":
         key = _label_key(labels)
@@ -206,6 +207,14 @@ class Histogram(_Family):
                     self._counts[i] += 1
                     return
             self._counts[-1] += 1
+
+    def exemplar(self, trace_id: str, value: float) -> None:
+        """Attach the most recent exemplar observation (a request id the
+        flight recorder holds a full phase timeline for). Exposed as an
+        OpenMetrics-style comment after the `_count` line so a scrape
+        links a tail bucket to `GET /debug/requests`."""
+        with self._lock:
+            self._exemplar = (str(trace_id), float(value))
 
     def _touched(self) -> bool:
         return self._n > 0 or not self._children
@@ -296,6 +305,12 @@ class MetricsRegistry:
                         f"{_fmt(child._sum)}")
                     lines.append(
                         f"{fam.name}_count{_label_str(key)} {child._n}")
+                    if child._exemplar is not None:
+                        tid, val = child._exemplar
+                        lines.append(
+                            f"# EXEMPLAR {fam.name}{_label_str(key)} "
+                            f'trace_id="{_escape_label(tid)}" '
+                            f"value={_fmt(val)} see=/debug/requests")
                 else:
                     lines.append(
                         f"{fam.name}{_label_str(key)} "

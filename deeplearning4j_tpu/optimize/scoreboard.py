@@ -708,6 +708,33 @@ def check_rows(rows: Sequence[Dict[str, Any]],
     return failures, lines
 
 
+def _tier_extras_lines(row: Dict[str, Any]) -> List[str]:
+    """Per-tier latency / shed / starvation detail for rows whose
+    extras carry it (the serving_multimodel A/B) — one indented line
+    per tier plus a shed/starvation summary, so `bench.py report`
+    surfaces the tier SLO picture without re-running the bench."""
+    extras = row.get("extras") or {}
+    tiers = extras.get("tier_latency_ms")
+    out: List[str] = []
+    if isinstance(tiers, dict):
+        for tier in sorted(tiers, key=lambda t:
+                           {"critical": 0, "standard": 1,
+                            "batch": 2}.get(t, 9)):
+            v = tiers.get(tier) or {}
+            out.append(f"      tier {tier}: p50 {v.get('p50', 0):g}ms  "
+                       f"p99 {v.get('p99', 0):g}ms")
+    bits = []
+    if "tier_sheds" in extras:
+        bits.append(f"sheds {extras['tier_sheds']}")
+    if "starvation_total" in extras:
+        bits.append(f"starvation {extras['starvation_total']}")
+    if "fused_speedup" in extras:
+        bits.append(f"fused x{extras['fused_speedup']:g}")
+    if bits:
+        out.append("      " + "  ".join(bits))
+    return out
+
+
 def render_report(rows: Sequence[Dict[str, Any]],
                   baseline: Dict[str, float]) -> str:
     """Round-over-round trajectory per metric from the ledger: one
@@ -746,6 +773,7 @@ def render_report(rows: Sequence[Dict[str, Any]],
             out.append(f"  {ts}  sha={row.get('git_sha', '?')}  "
                        f"backend={row.get('backend', '?')}  "
                        f"[{flags}]  {val}{ratio}")
+            out.extend(_tier_extras_lines(row))
     for row in anon:
         ts = time.strftime("%Y-%m-%dT%H:%M:%S",
                            time.localtime(row.get("ts", 0)))

@@ -74,11 +74,13 @@ class Span:
     cancel() discards it (a `step` span opened before the iterator
     reported exhaustion)."""
 
-    __slots__ = ("name", "args", "_t0", "_ann", "_done")
+    __slots__ = ("name", "args", "cat", "_t0", "_ann", "_done")
 
-    def __init__(self, name: str, args: Dict[str, Any]):
+    def __init__(self, name: str, args: Dict[str, Any],
+                 cat: Optional[str] = None):
         self.name = name
         self.args = args
+        self.cat = cat
         self._ann = None
         self._done = False
         if _annotate:
@@ -101,7 +103,7 @@ class Span:
         dur = time.perf_counter() - self._t0
         if self._ann is not None:
             self._ann.__exit__(None, None, None)
-        _record(self.name, self._t0, dur, self.args)
+        _record(self.name, self._t0, dur, self.args, self.cat)
 
     def cancel(self):
         if self._done:
@@ -123,9 +125,11 @@ def _make_annotation(name: str, args: Dict[str, Any]):
 
 
 def _record(name: str, t0: float, dur: float,
-            args: Optional[Dict[str, Any]]):
+            args: Optional[Dict[str, Any]], cat: Optional[str] = None):
     ev = {"name": name, "ts": t0 * 1e6, "dur": dur * 1e6,
           "tid": threading.get_ident()}
+    if cat:
+        ev["cat"] = cat
     if args:
         ev["args"] = args
     _ring.append(ev)  # deque.append is atomic; maxlen bounds memory
@@ -163,29 +167,54 @@ def clear() -> None:
     _ring.clear()
 
 
-def span(name: str, **args):
+def span(name: str, cat: Optional[str] = None, **args):
     """Context manager for one interval; no-op (shared singleton) when
-    tracing is disabled."""
+    tracing is disabled. `cat` tags the Chrome-export category ("train"
+    when omitted)."""
     if not _enabled:
         return _NULL
-    return Span(name, args)
+    return Span(name, args, cat)
 
 
-def begin(name: str, **args):
+def begin(name: str, cat: Optional[str] = None, **args):
     """Explicitly-ended span for intervals that cannot nest lexically
     (the step span opened before the iterator is polled)."""
     if not _enabled:
         return _NULL
-    return Span(name, args)
+    return Span(name, args, cat)
 
 
-def add_span(name: str, start: float, dur_s: float, **args) -> None:
+def add_span(name: str, start: float, dur_s: float,
+             cat: Optional[str] = None, **args) -> None:
     """Record a retroactive span from an already-measured interval
     (`start` in time.perf_counter seconds): the fit loops time ETL with
-    perf_counter anyway, so the span costs nothing extra."""
+    perf_counter anyway, so the span costs nothing extra. `cat` tags the
+    event category in the Chrome export ("train" when omitted) — the
+    serving flight recorder uses "serve" so a serving incident and a
+    training profile separate cleanly in one viewer."""
     if not _enabled:
         return
-    _record(name, start, dur_s, args or None)
+    _record(name, start, dur_s, args or None, cat)
+
+
+def add_spans(spans, cat: Optional[str] = None, **args) -> None:
+    """Bulk `add_span`: `spans` is [(name, start_s, dur_s)]. One enabled
+    check and ONE shared args dict for the whole group — the flight
+    recorder emits seven phase spans per served request, and per-span
+    kwargs repacking is measurable at serving rates. The shared dict is
+    stored by reference; callers must not mutate it afterwards."""
+    if not _enabled:
+        return
+    shared = args or None
+    tid = threading.get_ident()
+    for name, start, dur_s in spans:
+        ev = {"name": name, "ts": start * 1e6, "dur": dur_s * 1e6,
+              "tid": tid}
+        if cat:
+            ev["cat"] = cat
+        if shared:
+            ev["args"] = shared
+        _ring.append(ev)
 
 
 def fence(step: int, value) -> Optional[float]:
@@ -217,7 +246,7 @@ def export_trace_events() -> Dict[str, Any]:
     for ev in list(_ring):
         out = {"name": ev["name"], "ph": "X", "pid": pid,
                "tid": ev["tid"], "ts": round(ev["ts"], 3),
-               "dur": round(ev["dur"], 3), "cat": "train"}
+               "dur": round(ev["dur"], 3), "cat": ev.get("cat", "train")}
         if "args" in ev:
             out["args"] = ev["args"]
         events.append(out)

@@ -71,11 +71,11 @@ class DeadlineExceededError(RuntimeError):
 
 class _Request:
     __slots__ = ("x", "event", "result", "error", "deadline", "transform",
-                 "tag")
+                 "tag", "trace")
 
     def __init__(self, x: np.ndarray, deadline: Optional[float] = None,
                  transform: Optional[Callable] = None,
-                 tag: Optional[str] = None):
+                 tag: Optional[str] = None, trace=None):
         self.x = x
         self.event = threading.Event()
         self.result: Optional[np.ndarray] = None
@@ -89,6 +89,10 @@ class _Request:
         # Routing identity for failure attribution (BatchExecutionError
         # .request_tags) — the member name inside a fused group.
         self.tag = tag
+        # Flight-recorder RequestTrace (serving/flight_recorder.py) or
+        # None (the default — every touch point below is one `is None`
+        # branch, keeping the untraced path identical).
+        self.trace = trace
 
     def expired(self, now: Optional[float] = None) -> bool:
         return self.deadline is not None and \
@@ -261,7 +265,7 @@ class ParallelInference:
     # ----------------------------------------------------------------- output
     def output(self, x, *, deadline: Optional[float] = None,
                transform: Optional[Callable] = None,
-               tag: Optional[str] = None) -> np.ndarray:
+               tag: Optional[str] = None, trace=None) -> np.ndarray:
         """Predict for one request (any leading batch size). Thread-safe;
         in BATCHED mode blocks until the coalesced forward containing this
         request completes (reference output() → observable wait).
@@ -276,7 +280,13 @@ class ParallelInference:
         `transform` post-processes this request's own row slice before
         the caller sees it (a fused group's member-column view); a
         raising transform fails only this request. `tag` names the
-        request for failure attribution (``err.request_tags``)."""
+        request for failure attribution (``err.request_tags``).
+
+        `trace` is an optional flight-recorder RequestTrace: the engine
+        marks phase cut-points on it as the request crosses queue /
+        pack / scheduler / forward / unpack (docs/observability.md
+        §"Request flight recorder"); None (the default) records
+        nothing."""
         x = np.asarray(x)
         if x.ndim == 0:
             raise ValueError("Request must have a leading batch dimension")
@@ -285,24 +295,34 @@ class ParallelInference:
                 raise ServerClosedError(
                     "ParallelInference has been shut down")
             with self._lock:
-                req = _Request(x, deadline, transform, tag)
+                req = _Request(x, deadline, transform, tag, trace)
                 if req.expired():
                     self._shed(req, "expired")
                     raise DeadlineExceededError(
                         "deadline passed before dispatch")
                 try:
                     with self._sched_slot(float(x.shape[0])):
+                        if trace is not None:
+                            # lock + slot wait ends here; no coalescing
+                            # in SEQUENTIAL mode so no queue/pack phases
+                            trace.mark("sched_wait")
+                            trace.mark("dispatch")
                         out = self._forward(x)
+                        if trace is not None:
+                            out = np.asarray(out)  # recorder result fence
+                            trace.mark("device")
                     self._require_finite(out)
                     if transform is not None:
                         out = transform(out)
+                    if trace is not None:
+                        trace.mark("unpack")
                 except (DeadlineExceededError, QueueFullError,
                         ServerClosedError):
                     raise
                 except BaseException as e:
                     raise self._batch_failure(e, 1, reqs=[req])
                 return out
-        req = _Request(x, deadline, transform, tag)
+        req = _Request(x, deadline, transform, tag, trace)
         # Enqueue under the same lock shutdown() uses to place its sentinel,
         # so no request can ever land BEHIND the sentinel and starve.
         with self._enqueue_lock:
@@ -333,15 +353,21 @@ class ParallelInference:
         """Deliver one request's row slice, through its transform when it
         carries one. A raising transform (e.g. a fused member's column
         turned non-finite) fails ONLY this request — batchmates already
-        have (or will get) their own slices."""
+        have (or will get) their own slices. The unpack mark lands
+        BEFORE event.set(): once the caller wakes it owns the trace, so
+        the engine must not touch it afterwards."""
         if r.transform is not None:
             try:
                 rows = r.transform(rows)
             except BaseException as te:
+                if r.trace is not None:
+                    r.trace.mark("unpack")
                 r.error = self._batch_failure(te, 1, reqs=[r])
                 r.event.set()
                 return
         r.result = rows
+        if r.trace is not None:
+            r.trace.mark("unpack")
         r.event.set()
 
     def _forward(self, x: np.ndarray) -> np.ndarray:
@@ -487,6 +513,8 @@ class ParallelInference:
         for r in batch:
             if r.expired(now):
                 self._shed(r, "expired")
+                if r.trace is not None:
+                    r.trace.mark("queue_wait")  # died waiting: show where
                 r.error = DeadlineExceededError(
                     "deadline passed while queued")
                 r.event.set()
@@ -495,6 +523,14 @@ class ParallelInference:
         batch = live
         if not batch:
             return
+        # Flight-recorder cut-points: one shared timestamp per phase
+        # boundary fans out to every traced batchmate (they rode the
+        # same forward, so they share the same timeline past this line).
+        traced = [r for r in batch if r.trace is not None]
+        if traced:
+            tq = time.perf_counter()
+            for r in traced:
+                r.trace.mark("queue_wait", tq)
         try:
             xs = np.concatenate([r.x for r in batch], axis=0)
             n = xs.shape[0]
@@ -504,10 +540,37 @@ class ParallelInference:
             # mask needed on the inference path (pad rows are sliced off
             # before any caller sees them).
             xs = repeat_tail_rows(xs, bucket - n)
+            if traced:
+                tp = time.perf_counter()
+                for r in traced:
+                    r.trace.mark("pack", tp)
+                    r.trace.ctx["batch_rows"] = n
+                    r.trace.ctx["bucket"] = bucket
             t0 = time.perf_counter()
             with self._lock:
                 with self._sched_slot(float(n)):
+                    if traced:
+                        # slot granted: sched_wait (incl. any swap-pause
+                        # lock stall) ends; dispatch is the host-side
+                        # gap from grant to the forward call below
+                        tg = time.perf_counter()
+                        po = (self.scheduler.last_passovers(
+                            self.sched_name)
+                            if self.scheduler is not None else 0)
+                        for r in traced:
+                            r.trace.mark("sched_wait", tg)
+                            r.trace.mark("dispatch")
+                            if po:
+                                r.trace.ctx["sched_passovers"] = po
                     out = self._forward(xs)
+                    if traced:
+                        # recorder-only result fence INSIDE the slot so
+                        # device compute is charged to the slot it used;
+                        # the untraced path never syncs here
+                        out = np.asarray(out)
+                        td = time.perf_counter()
+                        for r in traced:
+                            r.trace.mark("device", td)
                 dur = time.perf_counter() - t0
                 # EWMA seeds on the first forward, then smooths at 0.2 —
                 # reactive enough for the admission estimate, stable
@@ -535,6 +598,14 @@ class ParallelInference:
             # survives to run the next batch — a raising forward never
             # strands a caller and never kills the engine.
             err = self._batch_failure(e, len(batch), reqs=batch)
+            # Close the failed attempt's window on every traced request
+            # (forward/finite failures are device-phase by far the
+            # common case) so the timeline stays contiguous across the
+            # solo retries below, which append fresh phase segments.
+            for r in traced:
+                r.trace.mark("device")
+                r.trace.ctx["failed_attempts"] = \
+                    r.trace.ctx.get("failed_attempts", 0) + 1
             if len(batch) == 1:
                 batch[0].error = err
                 batch[0].event.set()
@@ -635,6 +706,8 @@ class ParallelInference:
         for r in batch:  # SLO late-shed, same contract as _run_batch
             if r.expired(now):
                 self._shed(r, "expired")
+                if r.trace is not None:
+                    r.trace.mark("queue_wait")
                 r.error = DeadlineExceededError(
                     "deadline passed while queued")
                 r.event.set()
@@ -643,6 +716,11 @@ class ParallelInference:
         batch = live
         if not batch:
             return
+        traced = [r for r in batch if r.trace is not None]
+        if traced:
+            tq = time.perf_counter()
+            for r in traced:
+                r.trace.mark("queue_wait", tq)
         try:
             # Chaos seam: an armed "serve.pack" plan fails the assembly
             # (and, below, the unpack) of a packed row deterministically.
@@ -656,11 +734,33 @@ class ParallelInference:
                 xs[0, ofs:ofs + t_i] = r.x[0]
                 segmask[0, ofs:ofs + t_i] = s
                 ofs += t_i
+            if traced:
+                tp = time.perf_counter()
+                for r in traced:
+                    r.trace.mark("pack", tp)
+                    r.trace.ctx["packed_with"] = len(batch)
+                    r.trace.ctx["packed_tokens"] = ofs
+                    r.trace.ctx["pack_bucket"] = self.pack_bucket
             t0 = time.perf_counter()
             with self._lock:
                 with self._sched_slot(float(len(batch))):
+                    if traced:
+                        tg = time.perf_counter()
+                        po = (self.scheduler.last_passovers(
+                            self.sched_name)
+                            if self.scheduler is not None else 0)
+                        for r in traced:
+                            r.trace.mark("sched_wait", tg)
+                            r.trace.mark("dispatch")
+                            if po:
+                                r.trace.ctx["sched_passovers"] = po
                     faults.fire("serve.forward")
                     out = self.model.output(xs, features_mask=segmask)
+                    if traced:
+                        out = np.asarray(out)  # recorder result fence
+                        td = time.perf_counter()
+                        for r in traced:
+                            r.trace.mark("device", td)
                 dur = time.perf_counter() - t0
                 self._ewma_batch_s = dur if self._ewma_batch_s <= 0.0 \
                     else 0.8 * self._ewma_batch_s + 0.2 * dur
@@ -686,6 +786,10 @@ class ParallelInference:
                 ofs += t_i
         except BaseException as e:
             err = self._batch_failure(e, len(batch), reqs=batch)
+            for r in traced:  # close the failed window (see _run_batch)
+                r.trace.mark("device")
+                r.trace.ctx["failed_attempts"] = \
+                    r.trace.ctx.get("failed_attempts", 0) + 1
             if len(batch) == 1:
                 batch[0].error = err
                 batch[0].event.set()

@@ -4,8 +4,13 @@ canary gate, priority-tier WFQ scheduling across co-resident models and
 fused cross-model batching — docs/serving.md) plus the k-NN and
 Keras-backend REST facades (reference
 deeplearning4j-nearestneighbor-server, SURVEY.md §2.11), all on the
-shared utils/http_server core."""
+shared utils/http_server core. The per-request flight recorder
+(serving/flight_recorder.py — phase-attributed tail latency,
+slow-request exemplars, GET /debug/requests + /trace) is exported as
+the `flight_recorder` submodule."""
+from . import flight_recorder
 from .breaker import BreakerOpenError, CircuitBreaker
+from .flight_recorder import RequestTrace
 from .gateway import ServingGateway
 from .keras_server import KerasBackendServer
 from .model_pool import FusedModelGroup, ModelEntry, ModelPool, SwapError

@@ -42,19 +42,28 @@ transform slices its columns out of the shared fused forward.
 Endpoints: POST /predict, POST /swap, POST /config (live tier/weight/
 packed-admission reconfiguration), GET /health, GET /models, GET /stats,
 GET /metrics (Prometheus exposition — scrape surface shared with
-UIServer, docs/observability.md). Metrics:
+UIServer, docs/observability.md), plus the flight-recorder surfaces
+GET /debug/requests?model=&tier= (slow-request exemplars) and
+GET /trace (Chrome trace export of serving spans) — both 404 until
+`serving.flight_recorder.enable()` (or DL4JTPU_FLIGHT_RECORDER=1) arms
+the recorder. Metrics:
 `serving_requests_total{model,status}`, `serving_admitted_total`,
 `serving_shed_total{model,reason}`, `serving_swaps_total{model,outcome}`,
 `serving_queue_depth{model}`, `serving_batch_failures_total{model}`,
 `serving_breaker_state{model}`,
 `serving_breaker_transitions_total{model,to}`,
+`serving_slo_breach_total{model,tier}` (always on — a transient SLO
+breach between scrapes is invisible to the p99 gauges),
 `serving_latency_ms{model}` histogram plus scrape-time
-`serving_latency_p50_ms`/`serving_latency_p99_ms` gauges.
+`serving_latency_p50_ms`/`serving_latency_p99_ms` gauges, and — with
+the recorder enabled — `serving_phase_ms{model,tier,phase}`
+(docs/observability.md §"Request flight recorder").
 Every request runs inside a `serve/request` tracing span.
 """
 from __future__ import annotations
 
 import collections
+import json
 import threading
 import time
 from typing import Any, Dict, Optional
@@ -67,9 +76,10 @@ from ..parallel.inference import (BatchExecutionError, DeadlineExceededError,
                                   NonFiniteOutputError, QueueFullError,
                                   ServerClosedError)
 from ..utils.http_server import JsonHttpServer
+from . import flight_recorder
 from .breaker import BreakerOpenError
 from .model_pool import ModelPool, SwapError
-from .scheduler import TierShedError
+from .scheduler import DEFAULT_TIER_SLO_MS, TierShedError
 
 __all__ = ["ServingGateway"]
 
@@ -101,12 +111,17 @@ class ServingGateway(JsonHttpServer):
         super().__init__(
             get_routes={"/health": self._health_route,
                         "/models": self._models_route,
-                        "/stats": self._stats_route},
+                        "/stats": self._stats_route,
+                        "/debug/requests": self._debug_requests_route},
             post_routes={"/predict": self._predict_route,
                          "/swap": self._swap_route,
                          "/config": self._config_route},
+            raw_get_routes={"/trace": self._trace_route},
             port=port, pool_size=pool_size, expose_metrics=True)
         self.pool = pool if pool is not None else ModelPool()
+        # Operator escape hatch: DL4JTPU_FLIGHT_RECORDER=1 arms the
+        # per-request recorder without a code change.
+        flight_recorder.maybe_enable_from_env()
         self.default_deadline_ms = default_deadline_ms
         self.shed_headroom = float(shed_headroom)
         self._lat_lock = threading.Lock()
@@ -130,6 +145,10 @@ class ServingGateway(JsonHttpServer):
             "serving_latency_ms",
             "End-to-end request latency through the gateway",
             buckets=LATENCY_BUCKETS_MS)
+        self._slo_breach_c = reg.counter(
+            "serving_slo_breach_total",
+            "Requests whose wall latency exceeded their tier's "
+            "serving_tier_slo_ms, counted at response time")
         reg.register_collector(self._collect_percentiles)
 
     # ------------------------------------------------------------ model mgmt
@@ -153,24 +172,32 @@ class ServingGateway(JsonHttpServer):
 
     # -------------------------------------------------------------- predict
     def predict(self, name: str, x, *,
-                deadline_ms: Optional[float] = None) -> np.ndarray:
+                deadline_ms: Optional[float] = None,
+                _trace_sink: Optional[list] = None) -> np.ndarray:
         """In-process entry point (the HTTP route is a thin wrapper).
         Raises DeadlineExceededError / QueueFullError on shed,
         BreakerOpenError when the model's circuit breaker fast-fails
         the request, BatchExecutionError (NonFiniteOutputError for
         NaN/Inf outputs) when the forward itself failed, KeyError on
-        unknown model."""
+        unknown model.
+
+        `_trace_sink` (private: the /predict route) receives the
+        completed flight-recorder summary when the recorder is enabled,
+        so the HTTP response can embed the phase timeline."""
         # Unknown model: plain KeyError, no metrics — client-supplied
         # junk names must not mint unbounded label cardinality.
         entry = self.pool.get(name)
         t0 = time.perf_counter()
         status = "error"
+        # Flight recorder (docs/observability.md): disabled (default)
+        # this is None and every touch below is one branch.
+        tr = flight_recorder.new_trace(name, entry.tier)
         try:
             if deadline_ms is None:
                 deadline_ms = self.default_deadline_ms
             deadline = None if deadline_ms is None else \
                 time.monotonic() + float(deadline_ms) / 1000.0
-            with tracing.span("serve/request", model=name):
+            with tracing.span("serve/request", cat="serve", model=name):
                 # Circuit breaker (docs/serving.md): an open breaker
                 # fast-fails BEFORE admission — no queue slot, no
                 # forward rows, a distinct terminal status. Half-open
@@ -212,10 +239,17 @@ class ServingGateway(JsonHttpServer):
                             f"meet deadline {deadline_ms}ms — shed at "
                             "admission")
                 self._admit_c.labels(model=name).inc()
+                if tr is not None:
+                    # admission = gateway entry → engine handoff
+                    # (breaker / tier-shed / SLO-estimate checks)
+                    tr.mark("admission")
+                    gname = entry.engine.sched_name
+                    if gname and gname != name:
+                        tr.ctx["fused_group"] = gname
                 try:
                     out = entry.engine.output(
                         x, deadline=deadline, transform=entry.transform,
-                        tag=name)
+                        tag=name, trace=tr)
                 except QueueFullError:
                     self._shed_c.labels(model=name,
                                         reason="queue_full").inc()
@@ -237,6 +271,25 @@ class ServingGateway(JsonHttpServer):
             tiered = self.pool.scheduler is not None
             if tiered:
                 self._lat_h.labels(tier=entry.tier).observe(dur_ms)
+            # SLO burn counter (always on, recorder or not): a breach
+            # between scrapes must leave a durable count behind.
+            slo_ms = self._tier_slo(entry.tier)
+            if slo_ms is not None and dur_ms > slo_ms:
+                self._slo_breach_c.labels(model=name,
+                                          tier=entry.tier).inc()
+            if tr is not None:
+                if not tr.marks:
+                    # request died in the admission checks (breaker
+                    # fast-fail / tier shed / hopeless deadline): the
+                    # whole timeline IS admission
+                    tr.mark("admission")
+                if entry.breaker is not None:
+                    tr.ctx["breaker"] = entry.breaker.state
+                summary = flight_recorder.complete(
+                    tr, status, dur_ms, slo_ms,
+                    want_summary=_trace_sink is not None)
+                if _trace_sink is not None and summary is not None:
+                    _trace_sink.append(summary)
             if status == "ok":
                 with self._lat_lock:
                     dq = self._latencies.get(name)
@@ -251,6 +304,16 @@ class ServingGateway(JsonHttpServer):
                                 entry.tier,
                                 collections.deque(maxlen=2048))
                         tq.append(dur_ms)
+
+    def _tier_slo(self, tier: Optional[str]) -> Optional[float]:
+        """The latency SLO a request of `tier` is judged against: the
+        scheduler's live per-tier config when the pool runs one, else
+        the documented defaults (an untiered pool still burns against
+        the standard-tier budget)."""
+        sch = self.pool.scheduler
+        if sch is not None:
+            return sch.tier_slo_ms.get(tier)
+        return DEFAULT_TIER_SLO_MS.get(tier)
 
     # ---------------------------------------------------------------- stats
     def stats(self) -> Dict[str, Any]:
@@ -322,12 +385,43 @@ class ServingGateway(JsonHttpServer):
     def _stats_route(self, _):
         return 200, self.stats()
 
+    def _debug_requests_route(self, params):
+        """GET /debug/requests?model=&tier= — the slow-request exemplar
+        store: full phase timelines + context of the last N over-SLO /
+        errored / shed requests (flight_recorder ring)."""
+        if not flight_recorder.is_enabled():
+            return 404, {"status": "error", "enabled": False,
+                         "error": "flight recorder disabled — enable "
+                                  "serving.flight_recorder or set "
+                                  "DL4JTPU_FLIGHT_RECORDER=1"}
+        params = params or {}
+        reqs = flight_recorder.exemplars(model=params.get("model"),
+                                         tier=params.get("tier"))
+        return 200, {"status": "ok", "enabled": True,
+                     "count": len(reqs), "requests": reqs}
+
+    def _trace_route(self):
+        """GET /trace — Chrome trace-event export of the span ring
+        (serving spans carry cat="serve"), same surface UIServer has
+        served since PR 2; gated behind the recorder enable flag."""
+        if not flight_recorder.is_enabled():
+            body = json.dumps(
+                {"status": "error", "enabled": False,
+                 "error": "flight recorder disabled — enable "
+                          "serving.flight_recorder or set "
+                          "DL4JTPU_FLIGHT_RECORDER=1"}).encode()
+            return 404, "application/json", body
+        body = json.dumps(tracing.export_trace_events()).encode()
+        return 200, "application/json", body
+
     def _predict_route(self, req: dict):
         name = req.get("model", "default")
         x = np.asarray(req["features"], np.float32)
         deadline_ms = req.get("deadline_ms")
+        sink = [] if flight_recorder.is_enabled() else None
         try:
-            out = self.predict(name, x, deadline_ms=deadline_ms)
+            out = self.predict(name, x, deadline_ms=deadline_ms,
+                               _trace_sink=sink)
         except KeyError as e:
             return 404, {"status": "error", "error": str(e)}
         except BreakerOpenError as e:
@@ -351,9 +445,12 @@ class ServingGateway(JsonHttpServer):
         except ServerClosedError as e:
             return 503, {"status": "error", "error": str(e)}
         entry = self.pool.get(name)
-        return 200, {"status": "ok", "model": name,
-                     "version": entry.version.get("file", "initial"),
-                     "predictions": np.asarray(out).tolist()}
+        resp = {"status": "ok", "model": name,
+                "version": entry.version.get("file", "initial"),
+                "predictions": np.asarray(out).tolist()}
+        if sink:
+            resp["trace"] = sink[0]
+        return 200, resp
 
     def _swap_route(self, req: dict):
         name = req.get("model", "default")

@@ -563,7 +563,8 @@ class ModelPool:
                     "reason": "already serving this checkpoint"}
         path = os.path.join(mgr.directory, rec["file"])
         model = entry.model
-        with tracing.span("serve/swap", model=name, file=rec.get("file")):
+        with tracing.span("serve/swap", cat="serve", model=name,
+                          file=rec.get("file")):
             # Decode + device-stage OUTSIDE the execution lock: traffic
             # keeps flowing while the npz trees are read. The live trees
             # are the templates, so a config/architecture drift fails
@@ -589,7 +590,11 @@ class ModelPool:
                    int(model.iteration), int(model.epoch))
             buckets = list(entry.engine.warmed_buckets) or [1]
             golden = entry.golden_batch
-            with entry.engine.paused():
+            # The pause window is the stall every queued request feels
+            # (their sched_wait phase) — record it as its own span so a
+            # serving-trace tail reads "swap in progress", not mystery.
+            with tracing.span("serve/swap_pause", cat="serve",
+                              model=name), entry.engine.paused():
                 old_out = None
                 if golden is not None:
                     # The canary reference: OLD params' outputs on the
@@ -843,8 +848,8 @@ class FusedModelGroup:
         path = os.path.join(mgr.directory, rec["file"])
         model = entry.model  # the member's SOLO network
         fused = self.fused_net
-        with tracing.span("serve/swap", model=name, group=self.name,
-                          file=rec.get("file")):
+        with tracing.span("serve/swap", cat="serve", model=name,
+                          group=self.name, file=rec.get("file")):
             try:
                 faults.fire("serve.decode")
                 meta = validate_checkpoint(path)
@@ -867,7 +872,8 @@ class FusedModelGroup:
             buckets = list(self.engine.warmed_buckets) or [1]
             golden = entry.golden_batch
             off, width = self.col_slices[name]
-            with self.engine.paused():
+            with tracing.span("serve/swap_pause", cat="serve",
+                              model=name), self.engine.paused():
                 old_cols = None
                 if golden is not None:
                     try:

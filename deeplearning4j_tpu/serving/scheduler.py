@@ -99,7 +99,8 @@ def register_metrics() -> None:
 
 class _SchedEntry:
     __slots__ = ("name", "tier", "tier_value", "weight", "deficit",
-                 "passed_over", "depth_fn", "dispatches", "starvations")
+                 "passed_over", "depth_fn", "dispatches", "starvations",
+                 "last_passovers")
 
     def __init__(self, name: str, tier: str, weight: float,
                  depth_fn: Optional[Callable[[], int]]):
@@ -112,6 +113,10 @@ class _SchedEntry:
         self.depth_fn = depth_fn  # queued-request gauge for should_shed
         self.dispatches = 0
         self.starvations = 0
+        # pass-over run length of the most recent GRANT (snapshotted
+        # before the grant resets passed_over): the flight recorder's
+        # "how many times was this batch's slot passed over" context
+        self.last_passovers = 0
 
 
 class _Waiter:
@@ -243,6 +248,7 @@ class DeviceScheduler:
         deficit and one pass-over (starvation fires past the budget)."""
         e = self._entries.get(picked)
         if e is not None:
+            e.last_passovers = e.passed_over
             e.passed_over = 0
             e.dispatches += 1
             self._disp_c.labels(model=picked, tier=e.tier).inc()
@@ -282,6 +288,14 @@ class DeviceScheduler:
                                 e.deficit - self.quantum / e.weight)
             self._waiters = []
             return best.name
+
+    def last_passovers(self, name: Optional[str]) -> int:
+        """Pass-over run length of `name`'s most recent slot grant (0
+        for unknown/unregistered names) — read by the engine right after
+        it wins the slot, as exemplar context."""
+        with self._cv:
+            e = self._entries.get(name)
+            return e.last_passovers if e is not None else 0
 
     # --------------------------------------------------------- admission
     def should_shed(self, name: str) -> Optional[str]:

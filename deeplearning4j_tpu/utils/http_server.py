@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import json
 import threading
+import urllib.parse
 from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
@@ -92,8 +93,8 @@ class JsonHttpServer:
                 self.end_headers()
                 self.wfile.write(body)
 
-            def _dispatch(self, routes, payload):
-                fn = routes.get(self.path)
+            def _dispatch(self, routes, payload, path=None):
+                fn = routes.get(path if path is not None else self.path)
                 if fn is None:
                     self._json(404, {"error": "unknown path"})
                     return
@@ -103,7 +104,11 @@ class JsonHttpServer:
                     self._json(400, {"error": str(e)})
 
             def do_GET(self):
-                raw = raw_get_routes.get(self.path)
+                # GET handlers receive the parsed query string (or None
+                # when there is none) — `/debug/requests?model=a&tier=b`
+                # routes on the bare path like every other endpoint.
+                path, _, query = self.path.partition("?")
+                raw = raw_get_routes.get(path)
                 if raw is not None:
                     try:
                         code, ctype, body = raw()
@@ -116,7 +121,9 @@ class JsonHttpServer:
                     self.end_headers()
                     self.wfile.write(body)
                     return
-                self._dispatch(get_routes, None)
+                params = (dict(urllib.parse.parse_qsl(query))
+                          if query else None)
+                self._dispatch(get_routes, params, path=path)
 
             def do_POST(self):
                 try:

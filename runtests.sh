@@ -76,6 +76,15 @@ JAX_PLATFORMS=cpu python tests/smoke_chaos_serving.py
 # counter frozen without queued work. Hard signal.alarm guard.
 JAX_PLATFORMS=cpu python tests/smoke_multimodel.py
 
+# Request flight-recorder smoke (docs/observability.md §request flight
+# recorder): recorder armed via env flag, concurrent HTTP through a
+# fused pair + packed-admission model — every 200 response embeds a
+# trace with monotonic non-overlapping phases summing to wall within
+# 10%, zero compiles after warmup, and the exemplar ring captures
+# EXACTLY the one chaos-delayed request with the delay attributed to
+# the device phase. Hard signal.alarm guard.
+JAX_PLATFORMS=cpu python tests/smoke_request_trace.py
+
 # Cluster-health smoke (docs/robustness.md §cluster-health): fake-clock
 # watchdog transitions (PeerLost/Desync), typed barrier timeout, and a
 # real SIGTERM'd child writing a grace checkpoint then resuming

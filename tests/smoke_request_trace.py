@@ -1,0 +1,274 @@
+"""Request flight-recorder smoke (docs/observability.md §"Request
+flight recorder", ISSUE 15): the recorder's acceptance check end to end
+over real HTTP, with the recorder armed via its env flag.
+
+Builds a gateway serving a two-member fused group (tier critical) plus
+a packed-admission attention model (tier standard), warms every bucket,
+then — under a CompilationTracker — drives concurrent /predict traffic
+from threaded clients. Asserts:
+
+* every response is 200 and embeds a ``trace`` whose phases are
+  monotonic, contiguous (non-overlapping by construction: each phase
+  starts where the previous ended) and sum to the reported wall
+  latency within 10%,
+* ZERO XLA compile events after warmup (the recorder's device fence is
+  an output-side np.asarray — it must not perturb the compiled path),
+* the exemplar ring stays EMPTY under healthy traffic and captures
+  exactly the one request delayed past its SLO via the ``delay:``
+  chaos grammar at serve.forward — with the delay attributed to the
+  ``device`` phase,
+* GET /debug/requests?model=... filters server-side and GET /trace
+  exports Chrome-traceable serve/* events.
+
+A hard wall-clock alarm guards the whole run. Run by runtests.sh as a
+separate step (no test_ prefix on purpose).
+Usage: JAX_PLATFORMS=cpu python tests/smoke_request_trace.py
+"""
+import json
+import os
+import signal
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("DL4JTPU_FLIGHT_RECORDER", "32")  # noqa: E402
+
+from deeplearning4j_tpu import (Adam, DenseLayer, InputType,  # noqa: E402
+                                MultiLayerNetwork, NeuralNetConfiguration,
+                                OutputLayer, RnnOutputLayer, WeightInit)
+from deeplearning4j_tpu.nn.graph.graph import ComputationGraph  # noqa: E402
+from deeplearning4j_tpu.nn.layers.attention import (  # noqa: E402
+    SelfAttentionLayer)
+from deeplearning4j_tpu.optimize.telemetry import (  # noqa: E402
+    CompilationTracker)
+from deeplearning4j_tpu.serving import (ServingGateway,  # noqa: E402
+                                        flight_recorder)
+from deeplearning4j_tpu.serving.model_pool import ModelPool  # noqa: E402
+from deeplearning4j_tpu.serving.scheduler import DeviceScheduler  # noqa: E402
+from deeplearning4j_tpu.utils import faults  # noqa: E402
+
+HARD_TIMEOUT_S = 300
+FEAT = 8
+BUCKET = 16
+# generous SLOs so healthy 1-core traffic never breaches: the ONLY
+# exemplar this smoke may produce is the chaos-delayed request
+TIER_SLO_MS = {"critical": 2000.0, "standard": 2000.0, "batch": 8000.0}
+CHAOS_DELAY_MS = 2400  # > the critical SLO -> guaranteed exemplar
+PHASES = list(flight_recorder.PHASES)
+
+
+def _alarm(_sig, _frm):
+    print("SMOKE FAIL: hard wall-clock alarm fired — a request or the "
+          "scheduler slot is wedged", file=sys.stderr)
+    os._exit(2)
+
+
+def graph_net(seed):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(Adam(learning_rate=0.05))
+            .weight_init(WeightInit.XAVIER)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("dense", DenseLayer(n_out=16, activation="tanh"),
+                       "in")
+            .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                          loss="mcxent"), "dense")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(4))
+            .build())
+    return ComputationGraph(conf).init()
+
+
+def packed_net(seed=5):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(Adam(1e-3)).list()
+            .layer(SelfAttentionLayer(n_out=8, n_heads=2, causal=True,
+                                      packed_segments=True))
+            .layer(RnnOutputLayer(n_out=3, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(InputType.recurrent(FEAT)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def post(url, payload):
+    req = urllib.request.Request(
+        url, json.dumps(payload).encode(),
+        {"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def get(url):
+    try:
+        with urllib.request.urlopen(url) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def check_trace(trace, failures, who):
+    """Monotonic + contiguous phases that sum to wall within 10%."""
+    phases = trace.get("phases") or []
+    names = [p["phase"] for p in phases]
+    if names != PHASES:
+        failures.append(f"{who}: phases {names} != {PHASES}")
+        return
+    cursor = 0.0
+    for p in phases:
+        if p["ms"] < 0.0:
+            failures.append(f"{who}: negative phase {p}")
+            return
+        if abs(p["start_ms"] - cursor) > 0.05:
+            failures.append(
+                f"{who}: phase {p['phase']} starts at {p['start_ms']:.3f}"
+                f"ms, previous ended at {cursor:.3f}ms (overlap/gap)")
+            return
+        cursor = p["start_ms"] + p["ms"]
+    wall = trace.get("wall_ms", 0.0)
+    total = sum(p["ms"] for p in phases)
+    # phases end at the unpack mark; wall adds only the caller wake-up
+    if total > wall + 0.05 or (wall - total) > 0.10 * wall + 5.0:
+        failures.append(
+            f"{who}: phase sum {total:.2f}ms vs wall {wall:.2f}ms "
+            "outside the 10% budget")
+
+
+def main() -> int:
+    signal.signal(signal.SIGALRM, _alarm)
+    signal.alarm(HARD_TIMEOUT_S)
+    failures = []
+
+    pool = ModelPool(DeviceScheduler(tier_slo_ms=dict(TIER_SLO_MS)))
+    gw = ServingGateway(pool)
+    if not flight_recorder.is_enabled():
+        print("SMOKE FAIL: env flag did not arm the recorder",
+              file=sys.stderr)
+        return 1
+
+    gw.add_fused_group("duo", [("a", graph_net(1)), ("b", graph_net(2))],
+                       batch_limit=8, tier="critical", weight=2.0)
+    gw.add_model("p", packed_net(), tier="standard", batch_limit=8,
+                 batch_timeout_ms=10.0, packed_admission=True,
+                 pack_bucket=BUCKET)
+    gw.warmup("a")
+    gw.warmup("p", max_bucket=1, time_steps=BUCKET)
+
+    rng = np.random.default_rng(7)
+    fused_x = [rng.standard_normal((1 + i % 4, 4)).astype(np.float32)
+               for i in range(6)]
+    packed_x = [rng.standard_normal((1, 2 + i % 6, FEAT)).astype(np.float32)
+                for i in range(6)]
+
+    responses = []
+    errors = []
+
+    def client(i):
+        nm = ("a", "b", "p")[i % 3]
+        try:
+            for j in range(6):
+                x = packed_x[j] if nm == "p" else fused_x[j]
+                code, body = post(gw.url + "/predict",
+                                  {"model": nm, "features": x.tolist()})
+                responses.append((nm, code, body))
+        except Exception as e:  # noqa: BLE001 - smoke collects everything
+            errors.append(e)
+
+    with gw, CompilationTracker() as trk:
+        ts = [threading.Thread(target=client, args=(i,)) for i in range(6)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+
+        # healthy traffic: every response 200 with a well-formed trace,
+        # and the exemplar ring is still empty
+        for nm, code, body in responses:
+            if code != 200 or body.get("status") != "ok":
+                failures.append(
+                    f"{nm}: {code}/{body.get('status')} under healthy "
+                    "load")
+            elif "trace" not in body:
+                failures.append(f"{nm}: 200 response without a trace")
+            else:
+                check_trace(body["trace"], failures, nm)
+        code, dbg = get(gw.url + "/debug/requests")
+        if code != 200 or dbg.get("count") != 0:
+            failures.append("exemplar ring not empty under healthy "
+                            f"traffic: {dbg}")
+
+        # chaos window: delay ONE request past its SLO at serve.forward
+        # — it must become the only exemplar, attributed to `device`
+        with faults.injected("serve.forward",
+                             f"delay:1@{CHAOS_DELAY_MS}"):
+            code, slow_body = post(gw.url + "/predict",
+                                   {"model": "a",
+                                    "features": fused_x[0].tolist()})
+        if code != 200:
+            failures.append(f"chaos-delayed request failed: {code}")
+        slow_trace = slow_body.get("trace") or {}
+        for nm, x in (("b", fused_x[1]), ("p", packed_x[0])):
+            code, body = post(gw.url + "/predict",
+                              {"model": nm, "features": x.tolist()})
+            if code != 200:
+                failures.append(f"post-chaos {nm} request failed: {code}")
+
+        code, dbg = get(gw.url + "/debug/requests?model=a&tier=critical")
+        exm = dbg.get("requests", [])
+        if code != 200 or len(exm) != 1:
+            failures.append("expected exactly the chaos-delayed request "
+                            f"as exemplar, got {dbg.get('count')}")
+        elif exm[0].get("id") != slow_trace.get("id"):
+            failures.append(
+                f"exemplar id {exm[0].get('id')} != delayed request "
+                f"trace id {slow_trace.get('id')}")
+        else:
+            dev = sum(p["ms"] for p in exm[0]["phases"]
+                      if p["phase"] == "device")
+            if dev < 0.8 * CHAOS_DELAY_MS:
+                failures.append(
+                    f"delay at serve.forward attributed {dev:.1f}ms to "
+                    f"device, expected >= {0.8 * CHAOS_DELAY_MS:.0f}ms")
+        code, dbg = get(gw.url + "/debug/requests?model=p")
+        if code != 200 or dbg.get("count") != 0:
+            failures.append("model filter leaked foreign exemplars: "
+                            f"{dbg}")
+
+        with urllib.request.urlopen(gw.url + "/trace") as r:
+            events = json.loads(r.read()).get("traceEvents", [])
+        serve_evs = [e for e in events if e.get("cat") == "serve"]
+        if not any(e.get("name") == "serve/device" for e in serve_evs):
+            failures.append("/trace exports no serve/device spans")
+    gw.pool.shutdown()
+    flight_recorder.disable()
+
+    if errors:
+        failures.append(f"{len(errors)} client(s) errored: {errors[:3]}")
+    if len(responses) != 36:
+        failures.append(f"only {len(responses)}/36 requests completed")
+    if trk.count != 0:
+        failures.append(f"{trk.count} XLA compile(s) after warmup — the "
+                        "recorder must not perturb the compiled path")
+
+    signal.alarm(0)
+    if failures:
+        for f in failures:
+            print(f"SMOKE FAIL: {f}", file=sys.stderr)
+        return 1
+    print(f"request-trace smoke OK: {len(responses)} traced requests "
+          "across a fused pair + packed model, phases contiguous and "
+          "within 10% of wall, 0 compiles after warmup, exemplar ring "
+          "captured exactly the chaos-delayed request (device-phase "
+          "attribution), /trace exports serve spans")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
