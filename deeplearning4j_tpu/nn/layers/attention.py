@@ -16,6 +16,7 @@ import jax.numpy as jnp
 
 from ...ops.attention import (active_sequence_parallel, pick_block_size,
                               ring_self_attention, single_device_attention)
+from ...quantize import matmul_any
 from ...utils import serde
 from .core import Layer, dropout
 
@@ -116,9 +117,11 @@ class SelfAttentionLayer(Layer):
         b, t, _ = x.shape
         h = self.n_heads
         d = self.n_out // h
-        q = (x @ params[W_Q] + params[B_Q]).reshape(b, t, h, d)
-        k = (x @ params[W_K] + params[B_K]).reshape(b, t, h, d)
-        v = (x @ params[W_V] + params[B_V]).reshape(b, t, h, d)
+        # matmul_any: bf16-quantized projection weights compute in bf16
+        # with an fp32 epilogue; fp32 weights take the original ops.
+        q = matmul_any(x, params[W_Q], params[B_Q]).reshape(b, t, h, d)
+        k = matmul_any(x, params[W_K], params[B_K]).reshape(b, t, h, d)
+        v = matmul_any(x, params[W_V], params[B_V]).reshape(b, t, h, d)
         seg = None
         if self.packed_segments and mask is not None:
             seg = mask.astype(jnp.int32)
@@ -185,7 +188,7 @@ class SelfAttentionLayer(Layer):
                 segment_ids=seg,
                 impl=self.attention_impl, block_size=self.block_size)
         out = out.reshape(b, t, self.n_out)
-        out = out @ params[W_O] + params[B_O]
+        out = matmul_any(out, params[W_O], params[B_O])
         out = self._act()(out)
         if mask is not None:
             # zero masked timesteps POST-activation (the recurrent-layer

@@ -202,7 +202,11 @@ class ConvolutionLayer(Layer):
 
     def forward(self, params, state, x, *, train=False, rng=None, mask=None):
         x = dropout(x, self.dropout_rate, train, rng)
-        out = self._conv(x, params[WEIGHT]) + params[BIAS]
+        w = params[WEIGHT]
+        # bf16-quantized kernels (quantize.quantize_tree) compute the
+        # conv in bf16; the f32 bias add promotes the epilogue back up.
+        xc = x.astype(w.dtype) if w.dtype == jnp.bfloat16 else x
+        out = self._conv(xc, w) + params[BIAS]
         return self._act()(out.astype(x.dtype)), state
 
 
@@ -237,7 +241,9 @@ class Convolution1DLayer(ConvolutionLayer):
     def forward(self, params, state, x, *, train=False, rng=None, mask=None):
         x = dropout(x, self.dropout_rate, train, rng)
         x4 = x[:, :, None, :]  # [b, t, 1, f] as NHWC
-        out = self._conv4d_1d(x4, params[WEIGHT]) + params[BIAS]
+        w = params[WEIGHT]
+        x4 = x4.astype(w.dtype) if w.dtype == jnp.bfloat16 else x4
+        out = self._conv4d_1d(x4, w) + params[BIAS]
         return self._act()(out[:, :, 0, :]), state
 
     def init_params(self, key, dtype=jnp.float32):

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 
 from ...ops import activations as act_ops
 from ...ops import losses as loss_ops
+from ...quantize import quantize as quantize_mod
 from ...utils import serde
 from ..conf.inputs import (ConvolutionalType, FeedForwardType, InputType,
                            RecurrentType)
@@ -178,7 +179,13 @@ class DenseLayer(Layer):
         return {WEIGHT: w, BIAS: b}
 
     def preout(self, params, x):
-        return x @ params[WEIGHT] + params[BIAS]
+        # Serving may hand this layer a quantized dict (W_q/W_scale
+        # replacing W — quantize.quantize_tree); the branch is a Python
+        # dict-key check at trace time, so fp32 training graphs are
+        # bit-identical to before.
+        if quantize_mod.QUANT_WEIGHT in params:
+            return quantize_mod.dense_qforward(params, x)
+        return quantize_mod.matmul_any(x, params[WEIGHT], params[BIAS])
 
     def forward(self, params, state, x, *, train=False, rng=None, mask=None):
         x = dropout(x, self.dropout_rate, train, rng)
@@ -213,7 +220,10 @@ class EmbeddingLayer(Layer):
         idx = x.astype(jnp.int32)
         if idx.ndim == 2 and idx.shape[-1] == 1:
             idx = idx[:, 0]
-        out = jnp.take(params[WEIGHT], idx, axis=0) + params[BIAS]
+        if quantize_mod.QUANT_WEIGHT in params:
+            out = quantize_mod.embedding_qlookup(params, idx)
+        else:
+            out = jnp.take(params[WEIGHT], idx, axis=0) + params[BIAS]
         return self._act()(out), state
 
 

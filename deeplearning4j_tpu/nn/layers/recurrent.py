@@ -44,6 +44,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ...ops import activations as act_ops
+from ...quantize import matmul_any
 from ...utils import serde
 from ..conf.inputs import InputType, RecurrentType
 from ..weights import WeightInit
@@ -161,7 +162,10 @@ class LSTM(Layer):
         timesteps in one [B*T, n_in] @ [n_in, 4H] matmul (plus bias):
         hoisted out of the scan so the MXU sees one large contraction
         instead of T small ones."""
-        return x @ params[prefix + WEIGHT] + params[prefix + BIAS]
+        # matmul_any: bf16-quantized serving weights compute the big
+        # hoisted contraction in bf16 with an fp32 epilogue.
+        return matmul_any(x, params[prefix + WEIGHT],
+                          params[prefix + BIAS])
 
     def _cell(self, params, prefix=""):
         H = self.n_out
@@ -174,7 +178,7 @@ class LSTM(Layer):
                           params[prefix + PEEP_G])
 
         def cell(zxt, h, c):
-            z = zxt + h @ RW  # [B, 4H], gate order [i, f, o, g]
+            z = zxt + matmul_any(h, RW)  # [B, 4H], order [i, f, o, g]
             zi, zf, zo, zg = (z[:, :H], z[:, H:2 * H], z[:, 2 * H:3 * H],
                               z[:, 3 * H:])
             i = act(zi)  # candidate: LAYER activation (LSTMHelpers:194)

@@ -35,6 +35,8 @@ from __future__ import annotations
 
 import functools
 import logging
+import os
+import time
 from typing import Callable, Dict, Tuple
 
 import jax
@@ -241,3 +243,167 @@ def tpu_kernel_available() -> bool:
     the eager-probe rationale — a traced first call once silently
     disabled the kernel for the whole process)."""
     return kernel_probe("lrn", _lrn_probe)
+
+
+# ---------------------------------------------------------------------------
+# int8 matmul for the quantized serving path (docs/design.md
+# "Quantized serving"). Three candidate implementations of the same
+# contract — s8[B,K] x s8[N,K] -> s32[B,N], weights transposed so each
+# output channel is one contiguous row — and a MEASURED per-backend
+# dispatch under the LRN honesty rule: a one-time timed probe at a
+# serving-representative shape picks the winner, the losers stay
+# standing for the bench.py quant_matmul_ab A/B row.
+#
+# Why three arms exist at all (CPU rig, 2026-08): XLA's CPU backend has
+# no int8 dot emitter — an s8 dot_general materializes an s32 copy of
+# the weight operand and runs ~0.2x fp32, and its bf16 dot converts the
+# weights back to f32. The native AVX512-VNNI kernel
+# (native/quant_gemm.cpp as an XLA typed-FFI custom call; ~105us at
+# [8,1024]x[1024,1024] vs ~470us fp32 — the pure_callback bridge it
+# replaced cost ~1ms/call in trampoline alone) measures 3-5x FASTER
+# than the fp32 matmul at serving shapes. On TPU the Pallas kernel
+# feeds the MXU's native int8 path with no host round-trip and the XLA
+# arm is the portable fallback. None of that is assumed: whichever arm
+# wins the probe on the running backend ships.
+# ---------------------------------------------------------------------------
+
+_QUANT_BLOCK_N = 256  # output channels per grid step (VMEM-friendly)
+
+#: force the dispatch (tests / bench A/B arms): native | pallas | xla
+QUANT_MATMUL_ENV = "DL4JTPU_QUANT_MATMUL"
+
+_quant_impl: Dict[str, str] = {}  # backend -> winning arm
+
+
+def _int8_matmul_kernel(x_ref, w_ref, o_ref):
+    # Contraction over the shared K axis of x[B,K] and w[N,K]; MXU int8
+    # path needs the accumulator type pinned (pallas_guide: always pass
+    # preferred_element_type).
+    o_ref[:] = lax.dot_general(
+        x_ref[:], w_ref[:], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+
+
+def int8_matmul_pallas(x_q, w_q, interpret: bool = False):
+    """Pallas arm: x stays whole in VMEM (serving batches are small),
+    grid over output-channel blocks. int8 pads to the (32, 128) minimum
+    tile; zero padding is exact for a dot (0-products)."""
+    from jax.experimental import pallas as pl
+
+    b, k = x_q.shape
+    n = w_q.shape[0]
+    xp = pad_axis_to(pad_axis_to(x_q, 0, 32), 1, 128)
+    wp = pad_axis_to(pad_axis_to(w_q, 0, _QUANT_BLOCK_N), 1, 128)
+    bp, kp = xp.shape
+    out = pl.pallas_call(
+        _int8_matmul_kernel,
+        out_shape=jax.ShapeDtypeStruct((bp, wp.shape[0]), jnp.int32),
+        grid=(wp.shape[0] // _QUANT_BLOCK_N,),
+        in_specs=[pl.BlockSpec((bp, kp), lambda i: (0, 0)),
+                  pl.BlockSpec((_QUANT_BLOCK_N, kp), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((bp, _QUANT_BLOCK_N), lambda i: (0, i)),
+        interpret=interpret,
+    )(xp, wp)
+    return out[:b, :n]
+
+
+def int8_matmul_xla(x_q, w_q):
+    """XLA arm: the portable s8 x s8 -> s32 dot_general."""
+    return lax.dot_general(x_q, w_q, (((1,), (1,)), ((), ())),
+                           preferred_element_type=jnp.int32)
+
+
+def int8_matmul_native(x_q, w_q):
+    """Native arm: the AVX512-VNNI GEMM as an XLA custom call. The
+    typed-FFI handler (native/quant_gemm.cpp, registered once via
+    native_quant.ffi_register) hands the kernel raw XLA buffer pointers
+    in-process — measured ~1ms/call cheaper than the jax.pure_callback
+    bridge, whose python trampoline + marshalling costs an order of
+    magnitude more than the GEMM itself at serving shapes. The
+    pure_callback bridge stays as the degraded path for a .so built
+    without the jaxlib FFI headers; either way the math is exact
+    integer, so trace semantics hold."""
+    from .. import native_quant
+    out_t = jax.ShapeDtypeStruct((x_q.shape[0], w_q.shape[0]), jnp.int32)
+    if native_quant.ffi_register():
+        from jax.extend import ffi as jffi
+        return jffi.ffi_call(native_quant.FFI_TARGET, out_t)(x_q, w_q)
+    return jax.pure_callback(native_quant.int8_gemm, out_t,
+                             x_q, w_q, vectorized=False)
+
+
+def _int8_pallas_probe():
+    x = jnp.ones((8, 128), jnp.int8)
+    w = jnp.ones((8, 128), jnp.int8)
+    int8_matmul_pallas(x, w).block_until_ready()
+
+
+def int8_pallas_available() -> bool:
+    return kernel_probe("int8_matmul", _int8_pallas_probe)
+
+
+def _quant_candidates(backend: str) -> Dict[str, Callable]:
+    from .. import native_quant
+    cands: Dict[str, Callable] = {"xla": int8_matmul_xla}
+    if backend == "cpu" and native_quant.available():
+        cands["native"] = int8_matmul_native
+    if backend == "tpu" and int8_pallas_available():
+        cands["pallas"] = int8_matmul_pallas
+    return cands
+
+
+def _measure_quant_impl(backend: str) -> Tuple[str, Dict[str, float]]:
+    """Time every candidate arm eagerly at a serving-representative
+    shape and return (winner, per-arm best seconds). Eager (per-op)
+    dispatch overhead is tens of µs against ms-scale GEMMs, so the
+    ordering matches the jitted steady state; the native arm's
+    pure_callback hop is included in its own timing — no arm gets its
+    overhead waived."""
+    key = jax.random.PRNGKey(0)
+    x = jax.random.randint(key, (8, 1024), -127, 128, jnp.int8)
+    w = jax.random.randint(key, (1024, 1024), -127, 128, jnp.int8)
+    timings: Dict[str, float] = {}
+    for name, fn in _quant_candidates(backend).items():
+        try:
+            jax.block_until_ready(fn(x, w))  # compile/warm
+            best = float("inf")
+            for _ in range(5):
+                t0 = time.perf_counter()
+                jax.block_until_ready(fn(x, w))
+                best = min(best, time.perf_counter() - t0)
+            timings[name] = best
+        except Exception as e:  # an arm failing is a fallback, not a crash
+            log.info("quant_matmul arm %s unavailable (%s)", name, e)
+    winner = min(timings, key=timings.get) if timings else "xla"
+    return winner, timings
+
+
+def select_quant_impl() -> str:
+    """The measured per-backend dispatch decision, cached per process.
+    Runs eagerly even when first reached during a trace (the
+    kernel_probe rationale: a traced probe would poison the cache)."""
+    backend = jax.default_backend()
+    cached = _quant_impl.get(backend)
+    if cached is not None:
+        return cached
+    forced = os.environ.get(QUANT_MATMUL_ENV, "").strip().lower()
+    if forced in ("native", "pallas", "xla"):
+        _quant_impl[backend] = forced
+        return forced
+    with jax.ensure_compile_time_eval():
+        winner, timings = _measure_quant_impl(backend)
+    _quant_impl[backend] = winner
+    log.info("quant_matmul dispatch on %s: %s (%s)", backend, winner,
+             {k: f"{v * 1e6:.0f}us" for k, v in timings.items()})
+    return winner
+
+
+def quant_matmul(x_q, w_q):
+    """s8[B,K] x s8[N,K] -> s32[B,N] through the measured winner for
+    the current backend (see select_quant_impl)."""
+    impl = select_quant_impl()
+    if impl == "native":
+        return int8_matmul_native(x_q, w_q)
+    if impl == "pallas":
+        return int8_matmul_pallas(x_q, w_q)
+    return int8_matmul_xla(x_q, w_q)

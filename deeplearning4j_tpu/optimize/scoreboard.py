@@ -554,7 +554,34 @@ def validate_row(row: Any) -> List[str]:
             not isinstance(v, (int, float)) or isinstance(v, bool)
             for v in row["repeats"]):
         problems.append("repeats entries must be numbers")
+    problems.extend(_validate_row_kind(row))
     return problems
+
+
+# Per-workload extras contracts: a healthy row of these kinds without
+# its comparison/accuracy extras is a schema violation, not a style
+# choice — the quantized-serving A/B is only trustworthy if every row
+# records the drift the precision introduced alongside the speedup
+# (docs/serving.md §quantized: speed without an accuracy receipt is
+# how silent quality regressions ship).
+_ROW_KIND_EXTRAS: Dict[str, Tuple[str, ...]] = {
+    "serving_quant": ("quant_speedup_int8", "quant_speedup_bf16",
+                      "max_drift_int8", "max_drift_bf16"),
+    "quant_matmul_ab": ("winner", "dispatch_verdict",
+                        "int8_arms_bit_exact"),
+}
+
+
+def _validate_row_kind(row: Dict[str, Any]) -> List[str]:
+    required = _ROW_KIND_EXTRAS.get(row.get("workload"))
+    if not required or row.get("status") != "ok" or row.get("degraded"):
+        return []  # salvage rows are exempt (they are never scored)
+    extras = row.get("extras")
+    if not isinstance(extras, dict):
+        return [f"{row['workload']} row is missing extras "
+                f"({', '.join(required)})"]
+    return [f"{row['workload']} row extras missing {key!r}"
+            for key in required if key not in extras]
 
 
 def append_row(row: Dict[str, Any], path: Optional[str] = None) -> None:
@@ -730,6 +757,18 @@ def _tier_extras_lines(row: Dict[str, Any]) -> List[str]:
         bits.append(f"starvation {extras['starvation_total']}")
     if "fused_speedup" in extras:
         bits.append(f"fused x{extras['fused_speedup']:g}")
+    # Quantized-serving A/B detail (the serving_quant / quant_matmul_ab
+    # rows): speedup-with-drift so the report shows the accuracy cost
+    # next to the throughput win, and the dispatch verdict for the
+    # op-level row.
+    if "quant_speedup_int8" in extras:
+        bits.append(f"int8 x{extras['quant_speedup_int8']:g} "
+                    f"(drift {extras.get('max_drift_int8', 0):g})")
+    if "quant_speedup_bf16" in extras:
+        bits.append(f"bf16 x{extras['quant_speedup_bf16']:g} "
+                    f"(drift {extras.get('max_drift_bf16', 0):g})")
+    if "dispatch_verdict" in extras:
+        bits.append(f"dispatch {extras['dispatch_verdict']}")
     if bits:
         out.append("      " + "  ".join(bits))
     return out

@@ -1,0 +1,8 @@
+"""Post-training quantization for the serving plane (docs/design.md
+"Quantized serving"): spec-driven int8/bf16 param-tree transforms plus
+the dequant-free quantized forward helpers the layers dispatch to."""
+from .quantize import (  # noqa: F401
+    MODES, QUANT_SCALE, QUANT_WEIGHT, QUANT_ZERO, AlreadyQuantizedError,
+    QuantSpec, dense_qforward, dequantize_tree, embedding_qlookup,
+    matmul_any, quantize_tree, sidecar_scales, tree_precision,
+)

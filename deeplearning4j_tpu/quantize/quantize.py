@@ -1,0 +1,274 @@
+"""Post-training quantization of inference param trees.
+
+Jacob et al. (CVPR 2018) style per-channel weight quantization, plus a
+bf16 cast path, behind one spec interface (docs/design.md "Quantized
+serving"):
+
+* ``quantize_tree(params, spec)`` / ``dequantize_tree(qparams)`` — pure
+  functions over the nested param tree. Quantization is a *deployment*
+  decision made at swap time (serving/model_pool.swap(quantize=...)),
+  never a training-time flag: training trees stay fp32 and never see
+  this module.
+* int8 mode: dense-shaped subtrees (dicts whose keys are exactly the
+  core ``W``/``b`` pair with a 2-D float weight — DenseLayer, the
+  output heads, EmbeddingLayer) get symmetric per-output-channel int8:
+  ``W_scale[n] = max_k |W[k, n]| / 127``, ``W_q = round(W / scale)``
+  stored TRANSPOSED as s8 [n_out, n_in] so every output channel is a
+  unit-stride row (the layout ops.pallas_kernels.quant_matmul and the
+  native VNNI kernel consume directly — the forward never dequantizes
+  the weights). Optional asymmetric zero-points (``spec.zero_point``)
+  add an s32 ``W_zp`` per channel. Every other float leaf with ndim >= 2
+  (conv 4-D kernels, attention projections, recurrent W/RW — shapes
+  where int8 loses or the kernel doesn't reach) casts to bf16; biases
+  and 1-D stats stay fp32 so epilogues keep full precision.
+* bf16 mode: all float leaves with ndim >= 2 cast to bf16, rest
+  untouched — the low-risk arm (Kalamkar et al., 2019).
+
+Reserved keys ``W_q``/``W_scale``/``W_zp`` replace ``W`` in quantized
+dicts; re-quantizing a quantized tree raises the typed
+``AlreadyQuantizedError`` (idempotence is a bug here — it would stack
+scales silently). ``sidecar_scales`` extracts the scale/zero-point
+sidecar as its own tree for checkpoint/audit surfaces.
+
+The quantized *forward* helpers live here too (``dense_qforward``,
+``embedding_qlookup``, ``matmul_any``): int8 matmul with an fp32
+bias/activation epilogue and dynamic symmetric per-row activation
+quantization — activations are quantized on the fly inside the jitted
+forward (one abs-max per row), so no calibration pass is needed.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import pallas_kernels
+
+__all__ = [
+    "QuantSpec", "AlreadyQuantizedError", "MODES",
+    "QUANT_WEIGHT", "QUANT_SCALE", "QUANT_ZERO",
+    "quantize_tree", "dequantize_tree", "sidecar_scales",
+    "tree_precision", "dense_qforward", "embedding_qlookup",
+    "matmul_any",
+]
+
+#: reserved keys a quantized dense dict carries instead of ``W``
+QUANT_WEIGHT = "W_q"
+QUANT_SCALE = "W_scale"
+QUANT_ZERO = "W_zp"
+
+MODES = ("int8", "bf16")
+
+_DENSE_KEYS = {"W", "b"}
+
+
+class AlreadyQuantizedError(TypeError):
+    """Raised when quantize_tree sees a tree that already carries
+    quantized leaves — re-quantization is never idempotent (int8 of
+    int8 stacks scales; bf16 of bf16 silently halves mantissa twice),
+    so it is a typed error, not a no-op."""
+
+
+@dataclass(frozen=True)
+class QuantSpec:
+    """What to do to a param tree. ``mode`` picks the arm; zero-points
+    are optional (symmetric per-channel is the default — zero-centered
+    weight distributions waste <1 bit of range on it and the forward
+    stays correction-free)."""
+    mode: str = "int8"
+    zero_point: bool = False
+
+    def __post_init__(self):
+        if self.mode not in MODES:
+            raise ValueError(
+                f"QuantSpec.mode must be one of {MODES}, got {self.mode!r}")
+
+    @staticmethod
+    def coerce(spec: Union["QuantSpec", str]) -> "QuantSpec":
+        if isinstance(spec, QuantSpec):
+            return spec
+        return QuantSpec(mode=str(spec))
+
+
+def _is_float(leaf) -> bool:
+    return (hasattr(leaf, "dtype")
+            and jnp.issubdtype(leaf.dtype, jnp.floating))
+
+
+def _check_not_quantized(leaf) -> None:
+    if not hasattr(leaf, "dtype"):
+        return
+    if leaf.dtype == jnp.int8 or leaf.dtype == jnp.bfloat16:
+        raise AlreadyQuantizedError(
+            f"leaf dtype {leaf.dtype} is already quantized; "
+            "dequantize_tree first")
+
+
+def _quantize_dense(d: Dict[str, Any], spec: QuantSpec) -> Dict[str, Any]:
+    w = d["W"]
+    if spec.zero_point:
+        wmax = jnp.max(w, axis=0)
+        wmin = jnp.min(w, axis=0)
+        span = jnp.maximum(wmax - wmin, 1e-12)
+        scale = (span / 254.0).astype(jnp.float32)
+        # center of the range maps to q=0; 254 codes cover the span so
+        # rounding never clips
+        zp = jnp.round((wmax + wmin) / (2.0 * scale)).astype(jnp.int32)
+        q = jnp.clip(jnp.round(w / scale) - zp, -127, 127)
+        out = {QUANT_WEIGHT: q.astype(jnp.int8).T,
+               QUANT_SCALE: scale, QUANT_ZERO: zp}
+    else:
+        amax = jnp.max(jnp.abs(w), axis=0)
+        scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
+        q = jnp.clip(jnp.round(w / scale), -127, 127)
+        out = {QUANT_WEIGHT: q.astype(jnp.int8).T, QUANT_SCALE: scale}
+    if "b" in d:
+        out["b"] = d["b"]
+    return out
+
+
+def quantize_tree(params, spec: Union[QuantSpec, str] = "int8"):
+    """Quantize an inference param tree per ``spec``. Pure: returns a
+    new tree, input untouched. Raises AlreadyQuantizedError on any
+    already-quantized material anywhere in the tree."""
+    spec = QuantSpec.coerce(spec)
+
+    def walk(node):
+        if isinstance(node, dict):
+            if QUANT_WEIGHT in node or QUANT_SCALE in node:
+                raise AlreadyQuantizedError(
+                    "tree already carries W_q/W_scale sidecar keys; "
+                    "dequantize_tree first")
+            if (spec.mode == "int8" and set(node) <= _DENSE_KEYS
+                    and "W" in node and _is_float(node["W"])
+                    and node["W"].ndim == 2):
+                _check_not_quantized(node["W"])
+                return _quantize_dense(node, spec)
+            return {k: walk(v) for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            return type(node)(walk(v) for v in node)
+        _check_not_quantized(node)
+        if _is_float(node) and node.ndim >= 2:
+            return node.astype(jnp.bfloat16)
+        return node
+
+    return walk(params)
+
+
+def dequantize_tree(qparams):
+    """Reconstruct an fp32 tree from a quantized one (the rollback /
+    audit path). Exact inverse of the cast for bf16 mantissa bits;
+    within scale/2 per element for int8 (the property the round-trip
+    tests pin)."""
+
+    def walk(node):
+        if isinstance(node, dict):
+            if QUANT_WEIGHT in node:
+                q = node[QUANT_WEIGHT].astype(jnp.float32)
+                if QUANT_ZERO in node:
+                    q = q + node[QUANT_ZERO].astype(jnp.float32)[:, None]
+                w = (q * node[QUANT_SCALE][:, None]).T
+                out = {"W": w}
+                if "b" in node:
+                    out["b"] = node["b"]
+                return out
+            return {k: walk(v) for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            return type(node)(walk(v) for v in node)
+        if hasattr(node, "dtype") and node.dtype == jnp.bfloat16:
+            return node.astype(jnp.float32)
+        return node
+
+    return walk(qparams)
+
+
+def sidecar_scales(qparams):
+    """The scale/zero-point sidecar as its own tree (same dict shape,
+    quantized dicts reduced to their W_scale/W_zp entries) — the
+    checkpoint-audit surface the spec format documents."""
+
+    def walk(node):
+        if isinstance(node, dict):
+            if QUANT_WEIGHT in node:
+                out = {QUANT_SCALE: node[QUANT_SCALE]}
+                if QUANT_ZERO in node:
+                    out[QUANT_ZERO] = node[QUANT_ZERO]
+                return out
+            return {k: walk(v) for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            return type(node)(walk(v) for v in node)
+        return None
+
+    return walk(qparams)
+
+
+def tree_precision(params) -> str:
+    """Classify a param tree's serving precision: 'int8' if any int8
+    weight leaf, else 'bf16' if any bf16 leaf, else 'fp32' — the label
+    the swap plane stamps on metrics and traces."""
+    has_bf16 = False
+    for leaf in jax.tree_util.tree_leaves(params):
+        dt = getattr(leaf, "dtype", None)
+        if dt == jnp.int8:
+            return "int8"
+        if dt == jnp.bfloat16:
+            has_bf16 = True
+    return "bf16" if has_bf16 else "fp32"
+
+
+# ---------------------------------------------------------------------------
+# Quantized forwards (called from layer code at trace time; the branch
+# is a Python dict-key check, so fp32 training graphs are untouched)
+# ---------------------------------------------------------------------------
+
+def matmul_any(x, w, b=None):
+    """x @ w (+ b) with an fp32 epilogue whatever the weight dtype: the
+    bf16 arm casts the activations down for the product and back up
+    before bias, keeping the bias add and activation at full precision;
+    fp32 weights take the exact original ops."""
+    if w.dtype == jnp.bfloat16:
+        y = (x.astype(jnp.bfloat16) @ w).astype(jnp.float32)
+    else:
+        y = x @ w
+    return y if b is None else y + b
+
+
+def dense_qforward(params, x):
+    """Dense pre-activation from an int8-quantized dict: dynamic
+    symmetric per-row activation quantization, dequant-free int8
+    matmul, fp32 scale+bias epilogue.
+
+      x_scale[b] = max_k |x[b,k]| / 127      (on the fly, per request)
+      acc[b,n]   = sum_k x_q[b,k] * W_q[n,k]  (exact int32)
+      out[b,n]   = acc * x_scale[b] * W_scale[n] + bias[n]
+
+    With zero-points, ``W[k,n] = (W_q[n,k] + zp[n]) * scale[n]`` adds
+    the correction ``zp[n] * sum_k x_q[b,k]`` to the accumulator — one
+    row-sum, still integer-exact."""
+    w_q = params[QUANT_WEIGHT]
+    scale = params[QUANT_SCALE]
+    amax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    x_scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    x_q = jnp.clip(jnp.round(x / x_scale), -127, 127).astype(jnp.int8)
+    acc = pallas_kernels.quant_matmul(x_q, w_q)
+    if QUANT_ZERO in params:
+        rowsum = jnp.sum(x_q.astype(jnp.int32), axis=-1, keepdims=True)
+        acc = acc + params[QUANT_ZERO][None, :] * rowsum
+    out = acc.astype(jnp.float32) * (x_scale * scale[None, :])
+    b = params.get("b")
+    return out if b is None else out + b
+
+
+def embedding_qlookup(params, idx):
+    """Embedding rows from an int8 table: gather columns of the
+    transposed W_q, dequantize just the gathered slice (per-channel
+    scale), fp32 bias. Weight memory stays int8 end to end."""
+    w_q = params[QUANT_WEIGHT]          # [n_out, vocab]
+    cols = jnp.take(w_q, idx, axis=1).astype(jnp.float32)
+    if QUANT_ZERO in params:
+        cols = cols + params[QUANT_ZERO].astype(jnp.float32)[:, None]
+    out = (cols * params[QUANT_SCALE][:, None]).T
+    b = params.get("b")
+    return out if b is None else out + b

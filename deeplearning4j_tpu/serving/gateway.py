@@ -48,7 +48,7 @@ GET /trace (Chrome trace export of serving spans) — both 404 until
 `serving.flight_recorder.enable()` (or DL4JTPU_FLIGHT_RECORDER=1) arms
 the recorder. Metrics:
 `serving_requests_total{model,status}`, `serving_admitted_total`,
-`serving_shed_total{model,reason}`, `serving_swaps_total{model,outcome}`,
+`serving_shed_total{model,reason}`, `serving_swaps_total{model,outcome,precision}`,
 `serving_queue_depth{model}`, `serving_batch_failures_total{model}`,
 `serving_breaker_state{model}`,
 `serving_breaker_transitions_total{model,to}`,
@@ -243,6 +243,9 @@ class ServingGateway(JsonHttpServer):
                     # admission = gateway entry → engine handoff
                     # (breaker / tier-shed / SLO-estimate checks)
                     tr.mark("admission")
+                    # precision the forward will run at — makes the
+                    # quant A/B attributable per-phase in exemplars
+                    tr.ctx["precision"] = entry.precision
                     gname = entry.engine.sched_name
                     if gname and gname != name:
                         tr.ctx["fused_group"] = gname
@@ -283,6 +286,10 @@ class ServingGateway(JsonHttpServer):
                     # fast-fail / tier shed / hopeless deadline): the
                     # whole timeline IS admission
                     tr.mark("admission")
+                if "precision" not in tr.ctx:
+                    # fast-fail paths skip the admitted-path stamp; the
+                    # exemplar ring must label precision consistently
+                    tr.ctx["precision"] = entry.precision
                 if entry.breaker is not None:
                     tr.ctx["breaker"] = entry.breaker.state
                 summary = flight_recorder.complete(
@@ -454,8 +461,13 @@ class ServingGateway(JsonHttpServer):
 
     def _swap_route(self, req: dict):
         name = req.get("model", "default")
+        kw = {}
+        if req.get("quantize"):
+            # {"quantize": "int8" | "bf16" | "fp32"} promotes the
+            # checkpoint at that precision behind the canary gate
+            kw["quantize"] = str(req["quantize"])
         try:
-            return 200, self.swap(name)
+            return 200, self.swap(name, **kw)
         except KeyError as e:
             return 404, {"status": "error", "error": str(e)}
         except SwapError as e:

@@ -51,6 +51,7 @@ from ..optimize import tracing
 from ..optimize.metrics import registry
 from ..parallel.inference import (InferenceMode, NonFiniteOutputError,
                                   ParallelInference)
+from ..quantize import quantize as quantize_mod
 from ..utils import faults
 from ..utils.model_serializer import (PARAMS_ENTRY, STATE_ENTRY,
                                       CheckpointCorruptError,
@@ -74,12 +75,29 @@ class _CanaryRejected(RuntimeError):
     the canary_rejected swap outcome from a plain warm failure."""
 
 
-def _swap_counter(name: str, outcome: str):
+#: serving precisions the quantized swap plane can promote
+PRECISIONS = ("fp32", "bf16", "int8")
+
+
+def _swap_counter(name: str, outcome: str, precision: str = "fp32"):
     registry().counter(
         "serving_swaps_total",
         "Checkpoint hot-swap attempts by outcome "
-        "(ok/noop/failed/canary_rejected)"
-        ).labels(model=name, outcome=outcome).inc()
+        "(ok/noop/failed/canary_rejected) and target precision"
+        ).labels(model=name, outcome=outcome, precision=precision).inc()
+
+
+def _set_precision_gauge(name: str, precision: str):
+    """One-hot `serving_precision{model,precision}` gauge: the scrape
+    surface's answer to 'what precision is this model serving at right
+    now' without diffing swap counters."""
+    g = registry().gauge(
+        "serving_precision",
+        "Active serving precision per model (1 = the labeled "
+        "precision is live)")
+    for p in PRECISIONS:
+        g.labels(model=name, precision=p).set(
+            1.0 if p == precision else 0.0)
 
 
 def _fused_fallback_counter(reason: str, n: int = 1):
@@ -140,6 +158,10 @@ class ModelEntry:
         self.golden_batch = None if golden_batch is None else \
             np.asarray(golden_batch)
         self.canary_max_drift = canary_max_drift
+        # Active serving precision ("fp32" until a quantized swap
+        # promotes an int8/bf16 tree) — stamped on metrics, traces,
+        # and describe() so the A/B is attributable everywhere.
+        self.precision = "fp32"
         # Manifest record of the checkpoint currently serving; empty
         # until the first swap (initial params came from the caller,
         # not a published checkpoint).
@@ -159,6 +181,7 @@ class ModelEntry:
             "total_batch_failures": self.engine.total_batch_failures,
             "tier": self.tier,
             "weight": self.weight,
+            "precision": self.precision,
         }
         if self.group is not None:
             out["fused_group"] = self.group.name
@@ -340,6 +363,7 @@ class ModelPool:
             if name in self._entries:
                 raise ValueError(f"model {name!r} already registered")
             self._entries[name] = entry
+        _set_precision_gauge(name, entry.precision)
         if (self.scheduler is not None or tier != "standard"
                 or weight != 1.0):
             self._ensure_scheduler()
@@ -533,33 +557,52 @@ class ModelPool:
 
     # ---------------------------------------------------------------- swap
     def swap(self, name: str, *, manager=None,
-             time_steps: Optional[int] = None) -> Dict[str, Any]:
+             time_steps: Optional[int] = None,
+             quantize: Optional[str] = None) -> Dict[str, Any]:
         """Checkpoint-gated zero-downtime hot-swap (module docstring
         protocol). Returns {"swapped": bool, "model", "file",
-        "iteration"}; raises :class:`SwapError` when the gate or the
-        warm fails (old params keep serving either way)."""
+        "iteration", "precision"}; raises :class:`SwapError` when the
+        gate or the warm fails (old params keep serving either way).
+
+        `quantize` ("int8" | "bf16" | "fp32"/None) makes quantization a
+        DEPLOYMENT decision: the decoded fp32 checkpoint is quantized
+        via quantize.quantize_tree before promotion, and the golden-
+        batch canary compares the quantized outputs against the
+        currently-serving ones under `canary_max_drift` — a quantized
+        tree that drifts past the accuracy budget is rolled back with
+        the `canary_rejected` outcome exactly like a bad checkpoint."""
+        target = quantize or "fp32"
+        if target not in PRECISIONS:
+            _swap_counter(name, "failed", target)
+            raise SwapError(f"unknown quantize mode {quantize!r}; one of "
+                            f"{PRECISIONS}")
         entry = self.get(name)
         if entry.group is not None:
             # Fused-group member: the group owns the swap protocol (the
             # fused trees must be rebuilt under the SHARED engine's
             # pause). /swap stays per-member for callers either way.
             return entry.group.swap_member(name, manager=manager,
-                                           time_steps=time_steps)
+                                           time_steps=time_steps,
+                                           quantize=quantize)
         mgr = manager or entry.checkpoints
         if mgr is None:
-            _swap_counter(name, "failed")
+            _swap_counter(name, "failed", target)
             raise SwapError(f"model {name!r} has no CheckpointManager "
                             "attached — nothing to swap from")
         rec = mgr.latest_valid()
         if rec is None:
-            _swap_counter(name, "failed")
+            _swap_counter(name, "failed", target)
             raise SwapError(
                 f"no valid checkpoint in {mgr.directory!r} — manifest "
                 "empty or every entry torn/corrupt")
-        if rec.get("file") and rec.get("file") == entry.version.get("file"):
-            _swap_counter(name, "noop")
+        if (rec.get("file") and rec.get("file") == entry.version.get("file")
+                and target == entry.precision):
+            # Same file AND same precision: re-quantizing the serving
+            # checkpoint to a different precision is a real swap.
+            _swap_counter(name, "noop", target)
             return {"swapped": False, "model": name, "file": rec["file"],
                     "iteration": rec.get("iteration", 0),
+                    "precision": entry.precision,
                     "reason": "already serving this checkpoint"}
         path = os.path.join(mgr.directory, rec["file"])
         model = entry.model
@@ -573,16 +616,30 @@ class ModelPool:
             try:
                 faults.fire("serve.decode")
                 meta = validate_checkpoint(path)
+                # Checkpoints are always fp32: when the LIVE tree is
+                # quantized, the decode template is its dequantized
+                # shape (same treedef as the published file).
+                params_template = model.params_tree
+                if entry.precision != "fp32":
+                    params_template = quantize_mod.dequantize_tree(
+                        params_template)
                 with zipfile.ZipFile(path, "r") as zf:
                     new_params = _npz_bytes_to_tree(
                         _read_entry(zf, path, PARAMS_ENTRY),
-                        model.params_tree)
+                        params_template)
                     new_state = _npz_bytes_to_tree(
                         _read_entry(zf, path, STATE_ENTRY),
                         model.state_tree)
+                if target != "fp32":
+                    # Quantize OFF the hot path, before the pause: the
+                    # engine keeps serving old params while per-channel
+                    # scales are computed.
+                    new_params = quantize_mod.quantize_tree(
+                        new_params, target)
             except (CheckpointCorruptError, ValueError,
+                    quantize_mod.AlreadyQuantizedError,
                     faults.FaultInjected) as e:
-                _swap_counter(name, "failed")
+                _swap_counter(name, "failed", target)
                 raise SwapError(
                     f"checkpoint {rec.get('file')!r} cannot serve model "
                     f"{name!r}: {e}") from e
@@ -647,18 +704,23 @@ class ModelPool:
                         model._rnn_carry = None
                     canary = isinstance(e, _CanaryRejected)
                     _swap_counter(
-                        name, "canary_rejected" if canary else "failed")
+                        name, "canary_rejected" if canary else "failed",
+                        target)
                     what = ("canary gate rejected"
                             if canary else "warm forward failed on")
                     raise SwapError(
-                        f"{what} {rec.get('file')!r}; rolled back to "
-                        f"previous params: {e}") from e
+                        f"{what} {rec.get('file')!r} (precision "
+                        f"{target}); rolled back to previous params: "
+                        f"{e}") from e
         with self._lock:
             entry.version = dict(rec)
             entry.swaps += 1
-        _swap_counter(name, "ok")
+            entry.precision = target
+        _set_precision_gauge(name, target)
+        _swap_counter(name, "ok", target)
         return {"swapped": True, "model": name, "file": rec.get("file"),
-                "iteration": rec.get("iteration", 0)}
+                "iteration": rec.get("iteration", 0),
+                "precision": target}
 
     # ------------------------------------------------------------ lifecycle
     def shutdown(self) -> None:
@@ -813,7 +875,8 @@ class FusedModelGroup:
 
     # ---------------------------------------------------------------- swap
     def swap_member(self, name: str, *, manager=None,
-                    time_steps: Optional[int] = None) -> Dict[str, Any]:
+                    time_steps: Optional[int] = None,
+                    quantize: Optional[str] = None) -> Dict[str, Any]:
         """Per-member checkpoint hot-swap inside the fused group: the
         ModelPool.swap protocol with the fused forward as the execution
         substrate. The member's SOLO model stays the decode template and
@@ -828,6 +891,16 @@ class FusedModelGroup:
         if entry is None:
             raise KeyError(f"no member {name!r} in fused group "
                            f"{self.name!r}")
+        if quantize and quantize != "fp32":
+            # The fused forward runs ONE channel-concatenated weight
+            # per layer; a single member at a different precision would
+            # force per-member splits back into the fused matmul.
+            # Quantize the whole group or serve the member solo.
+            _swap_counter(name, "failed", quantize)
+            raise SwapError(
+                f"quantized swap is per-model; member {name!r} of fused "
+                f"group {self.name!r} cannot change precision alone "
+                "(eject it or serve it unfused)")
         mgr = manager or entry.checkpoints
         if mgr is None:
             _swap_counter(name, "failed")

@@ -92,6 +92,16 @@ JAX_PLATFORMS=cpu python tests/smoke_request_trace.py
 # regression can never wedge the gate itself.
 JAX_PLATFORMS=cpu python tests/smoke_cluster_health.py
 
+# Quantized hot-swap smoke (docs/serving.md §quantized): drive
+# concurrent in-process traffic through a live `swap(quantize="int8")`
+# promotion — zero non-typed failures, zero compiles after the
+# quantized warm, post-swap drift within the canary budget, the
+# precision="int8" label on entry/gauge/scrape — then a tight-budget
+# gateway where the SAME swap canary-rejects, bumps the
+# canary_rejected{precision="int8"} counter, and keeps serving the old
+# fp32 tree bitwise. Canary both ways, one gate.
+JAX_PLATFORMS=cpu python tests/smoke_quant_swap.py
+
 # Bench scoreboard smoke (docs/observability.md §bench-scoreboard): wedge
 # a real bench child mid-measurement via the bench.child delay fault and
 # assert the fail-safe plane holds — exit 0, the artifact parses with

@@ -1,0 +1,189 @@
+"""Quantized hot-swap smoke: the canary-both-ways acceptance check
+under live concurrent traffic (docs/serving.md §quantized).
+
+Builds a dense-MLP gateway with a golden batch and a drift budget,
+warmup()s every pow2 bucket, then — while concurrent clients hammer
+/predict in-process — drives the quantized swap plane both ways:
+
+* promote-on-pass: `swap(quantize="int8")` under a loose
+  `canary_max_drift` promotes, the result / entry / gauge / /metrics
+  exposition all carry precision="int8", and post-swap outputs stay
+  within the budget of the fp32 reference,
+* zero non-typed failures across the swap: every client request either
+  answers or raises a typed serving error (none expected here),
+* zero XLA compiles once the quantized warm completes (the int8 tree's
+  first trace through PrecompiledDispatch happens inside the seeding
+  pass below, NOT on the steady-state clock),
+* canary_rejected-on-drift: a second gateway with a tight budget
+  refuses the same quantized swap with the typed SwapError, bumps
+  serving_swaps_total{outcome="canary_rejected",precision="int8"}, and
+  the old fp32 tree keeps serving bitwise.
+
+Run by runtests.sh as a separate step (no test_ prefix on purpose —
+this is a concurrency/e2e smoke, not a pytest unit). Exits nonzero on
+any failed expectation.
+
+Usage: JAX_PLATFORMS=cpu python tests/smoke_quant_swap.py
+"""
+import os
+import sys
+import tempfile
+import threading
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deeplearning4j_tpu import (Adam, DenseLayer, InputType,  # noqa: E402
+                                MultiLayerNetwork, NeuralNetConfiguration,
+                                OutputLayer, WeightInit)
+from deeplearning4j_tpu.optimize.metrics import registry  # noqa: E402
+from deeplearning4j_tpu.optimize.resilience import CheckpointManager  # noqa: E402
+from deeplearning4j_tpu.optimize.telemetry import CompilationTracker  # noqa: E402
+from deeplearning4j_tpu.serving import ServingGateway, SwapError  # noqa: E402
+
+DRIFT_BUDGET = 0.05  # loose: int8 on this net drifts ~3e-3
+
+
+def make_net(seed=42, train_seed=7):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(Adam(learning_rate=0.05))
+            .weight_init(WeightInit.XAVIER).list()
+            .layer(DenseLayer(n_out=32, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(8)).build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(train_seed)
+    x = rng.standard_normal((16, 8)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+    net.fit(x, y, epochs=1, batch_size=16)
+    return net
+
+
+def main() -> int:
+    failures = []
+    rng = np.random.default_rng(0)
+    golden = rng.standard_normal((4, 8)).astype(np.float32)
+    payloads = [rng.standard_normal((1 + (i % 5), 8)).astype(np.float32)
+                for i in range(12)]
+
+    with tempfile.TemporaryDirectory(prefix="dl4jtpu_quant_smoke_") as d:
+        mgr = CheckpointManager(d)
+        mgr.save(make_net())
+
+        # ---- leg 1: promote-on-pass under live traffic ----------------
+        gw = ServingGateway()
+        gw.add_model("default", make_net(), checkpoints=mgr,
+                     batch_limit=8, golden_batch=golden,
+                     canary_max_drift=DRIFT_BUDGET)
+        gw.warmup()
+        ref = np.asarray(gw.predict("default", golden))
+        # Seed the int8 executables OUTSIDE the compile-silence window:
+        # the quantized tree's first trace rides PrecompiledDispatch's
+        # jit fall-through legitimately; steady state must not compile.
+        assert gw.swap("default", quantize="int8")["swapped"] is True
+        for p in payloads:
+            gw.predict("default", p)
+        assert gw.swap("default")["swapped"] is True  # back to fp32
+
+        stop = threading.Event()
+        errors, answered = [], []
+
+        def client(i):
+            k = i % len(payloads)
+            while not stop.is_set():
+                try:
+                    out = np.asarray(gw.predict("default", payloads[k]))
+                    if not np.isfinite(out).all():
+                        errors.append(AssertionError("non-finite output"))
+                        return
+                    answered.append(1)
+                except Exception as e:  # any error across a passing
+                    errors.append(e)   # swap is a failure, typed or not
+                    return
+
+        with CompilationTracker() as trk:
+            ts = [threading.Thread(target=client, args=(i,))
+                  for i in range(6)]
+            for t in ts:
+                t.start()
+            import time
+            time.sleep(0.2)  # live traffic flowing
+            try:
+                res = gw.swap("default", quantize="int8")
+                if res.get("swapped") is not True or \
+                        res.get("precision") != "int8":
+                    failures.append(f"int8 swap did not promote: {res}")
+            except SwapError as e:
+                failures.append(f"int8 swap rejected unexpectedly: {e}")
+            time.sleep(0.2)  # keep hammering post-swap
+            stop.set()
+            for t in ts:
+                t.join(timeout=30)
+            got = np.asarray(gw.predict("default", golden))
+
+        if errors:
+            failures.append(f"{len(errors)} client error(s) across the "
+                            f"quantized swap: {errors[:3]}")
+        if len(answered) < 20:
+            failures.append(f"only {len(answered)} requests answered")
+        if trk.count != 0:
+            failures.append(f"{trk.count} XLA compile(s) after the "
+                            "quantized warm — steady state must ride "
+                            "the cached executables")
+        drift = float(np.max(np.abs(got - ref)))
+        if drift > DRIFT_BUDGET:
+            failures.append(f"post-swap drift {drift:.4g} exceeds the "
+                            f"{DRIFT_BUDGET} budget the canary passed")
+        entry = gw.pool.get("default")
+        if entry.precision != "int8":
+            failures.append(f"entry precision {entry.precision!r} != int8")
+        metrics_text = registry().prometheus_text()
+        if 'precision="int8"' not in metrics_text:
+            failures.append('precision="int8" label missing from the '
+                            "metrics exposition")
+        gw.pool.shutdown()
+
+        # ---- leg 2: canary_rejected-on-drift, old tree keeps serving --
+        gw = ServingGateway()
+        gw.add_model("default", make_net(), checkpoints=mgr,
+                     batch_limit=8, golden_batch=golden,
+                     canary_max_drift=1e-9)
+        gw.warmup()
+        ref = np.asarray(gw.predict("default", golden))
+        rej = registry().counter("serving_swaps_total")
+        before = rej.value(model="default", outcome="canary_rejected",
+                           precision="int8")
+        try:
+            gw.swap("default", quantize="int8")
+            failures.append("tight-budget int8 swap was not rejected")
+        except SwapError as e:
+            if "canary" not in str(e):
+                failures.append(f"rejection is not the canary's: {e}")
+        after = rej.value(model="default", outcome="canary_rejected",
+                          precision="int8")
+        if after != before + 1:
+            failures.append("canary_rejected{precision=int8} counter "
+                            f"did not move ({before} -> {after})")
+        if gw.pool.get("default").precision != "fp32":
+            failures.append("precision changed on a rejected swap")
+        got = np.asarray(gw.predict("default", golden))
+        if not np.array_equal(got, ref):
+            failures.append("old fp32 outputs not bitwise after the "
+                            "rejected swap")
+        gw.pool.shutdown()
+
+    if failures:
+        for f in failures:
+            print(f"SMOKE FAIL: {f}", file=sys.stderr)
+        return 1
+    print(f"quant swap smoke OK: {len(answered)} requests served across "
+          f"a live int8 promotion (drift {drift:.2e} within "
+          f"{DRIFT_BUDGET}), 0 compiles post-warm, and the tight-budget "
+          "canary rejected with rollback")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

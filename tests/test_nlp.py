@@ -90,6 +90,7 @@ class TestWord2Vec:
         assert w2v._trainer.negative == 0
         assert np.abs(np.asarray(w2v._trainer.tables["syn1"])).sum() > 0.0
 
+    @pytest.mark.slow  # ~65s; hs-only and ns-only paths stay tier-1
     def test_hs_plus_ns_together(self):
         w2v = fit_w2v(use_hierarchic_softmax=True, negative_sample=3)
         assert np.abs(np.asarray(w2v._trainer.tables["syn1"])).sum() > 0.0
@@ -166,6 +167,7 @@ class TestParagraphVectors:
             getattr(b, k)(v)
         return b.build().fit(), docs
 
+    @pytest.mark.slow  # ~26s/param on the 1-core rig
     @pytest.mark.parametrize("algo", ["dbow", "dm"])
     def test_doc_vectors_cluster_by_topic(self, algo):
         """Relative assertions (reference ParagraphVectorsTest style): doc
@@ -200,6 +202,7 @@ class TestParagraphVectors:
                             for i in range(1, 20, 2)])
         assert animal_sim > food_sim, (animal_sim, food_sim)
 
+    @pytest.mark.slow  # ~33s (full hs fit + inference loop)
     def test_infer_vector_hs_path(self):
         pv, docs = self._fit("dbow", negative_sample=0, use_hierarchic_softmax=True)
         v = pv.infer_vector("bread cheese rice soup apple")
@@ -298,6 +301,7 @@ class TestVectorizers:
         # tf here so the tf-idf ordering follows idf
         assert row[v.vocab.index_of("cat")] > row[v.vocab.index_of("the")]
 
+    @pytest.mark.slow  # ~38s (w2v fit + CNN train)
     def test_cnn_sentence_iterator_trains(self):
         from deeplearning4j_tpu.nlp import (CnnSentenceDataSetIterator,
                                             Word2Vec)
@@ -356,6 +360,7 @@ class TestNode2Vec:
 
 
 class TestSequenceVectors:
+    @pytest.mark.slow  # ~21s on the 1-core rig
     def test_generic_elements(self):
         """The generic Sequence<T> engine (reference SequenceVectors):
         arbitrary hashable elements — here (kind, id) tuples — embed so

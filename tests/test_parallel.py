@@ -269,6 +269,7 @@ class TestGraftEntry:
         out = jax.jit(fn)(*args)
         assert out.shape == (4, 10)
 
+    @pytest.mark.slow  # ~360s on the 1-core rig (8 simulated chips)
     def test_dryrun_multichip(self):
         import __graft_entry__ as g
         g.dryrun_multichip(8)
@@ -356,6 +357,7 @@ class TestDPxRecurrent:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-4, atol=1e-5)
 
+    @pytest.mark.slow  # ~21s; the graph variant keeps tier-1 coverage
     def test_mln_tbptt_local_sgd_matches_manual_replicas(self):
         """char-RNN under averaging_frequency > 1 (the round-2
         NotImplementedError site): every replica runs the same window

@@ -73,6 +73,11 @@ class TestLSTMForward:
 
 
 class TestLSTMGradients:
+    # x64 finite-difference checks: ~20-40s per variant on the 1-core
+    # rig. Forward/backward parity for these cells stays tier-1 via the
+    # f32 training tests; the exhaustive grad checks run in the slow
+    # lane.
+    @pytest.mark.slow
     @pytest.mark.parametrize("cls", [LSTM, GravesLSTM, GravesBidirectionalLSTM])
     def test_gradient_check(self, cls):
         jax.config.update("jax_enable_x64", True)
@@ -84,6 +89,7 @@ class TestLSTMGradients:
         finally:
             jax.config.update("jax_enable_x64", False)
 
+    @pytest.mark.slow  # ~35s (x64 finite differences, masked variant)
     def test_gradient_check_masked(self):
         jax.config.update("jax_enable_x64", True)
         try:

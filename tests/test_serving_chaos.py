@@ -492,14 +492,14 @@ class TestCanaryGate:
         gw.add_model("m", net, checkpoints=mgr, batch_limit=4,
                      golden_batch=rand_x(2, seed=9))
         c0 = registry().counter("serving_swaps_total", "").value(
-            model="m", outcome="canary_rejected")
+            model="m", outcome="canary_rejected", precision="fp32")
         before = param_leaves(net)
         ref = net.output(rand_x(2, seed=9))
         try:
             with pytest.raises(SwapError, match="canary gate rejected"):
                 gw.swap("m")
             assert registry().counter("serving_swaps_total", "").value(
-                model="m", outcome="canary_rejected") == c0 + 1
+                model="m", outcome="canary_rejected", precision="fp32") == c0 + 1
             # bitwise rollback: every param leaf equals pre-swap bytes
             assert_leaves_equal(param_leaves(net), before)
             # and the OLD params are still the ones serving
@@ -557,13 +557,13 @@ class TestCanaryGate:
         gw.add_model("m", net, checkpoints=mgr, batch_limit=4)
         before = param_leaves(net)
         f0 = registry().counter("serving_swaps_total", "").value(
-            model="m", outcome="failed")
+            model="m", outcome="failed", precision="fp32")
         try:
             with faults.injected("swap.warm", "fail:1"):
                 with pytest.raises(SwapError, match="warm forward failed"):
                     gw.swap("m")
             assert registry().counter("serving_swaps_total", "").value(
-                model="m", outcome="failed") == f0 + 1
+                model="m", outcome="failed", precision="fp32") == f0 + 1
             assert_leaves_equal(param_leaves(net), before)
             # the chaos plan is exhausted: the retried swap goes through
             assert gw.swap("m")["swapped"] is True

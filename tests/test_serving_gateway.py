@@ -509,7 +509,7 @@ class TestHotSwap:
             for a, b in zip(leaves_live, leaves_ckpt):
                 np.testing.assert_array_equal(a, b)
             assert registry().counter("serving_swaps_total", "").labels(
-                model="m", outcome="ok").value() >= 1
+                model="m", outcome="ok", precision="fp32").value() >= 1
         finally:
             stop.set()
             gw.pool.shutdown()
