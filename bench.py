@@ -1055,6 +1055,180 @@ def bench_serving_multimodel(heads=3, clients=6, requests_per_client=120,
     }
 
 
+def bench_serving_autotune(run_s=6.0, shift_s=2.0, clients=3,
+                           bulk_clients=2, linger_ms=8.0,
+                           standard_slo_ms=6.0, interval_s=0.25,
+                           window_s=2.0):
+    """Self-tuning serving A/B (docs/observability.md §"The serving
+    control loop"): the SAME deliberately mis-tuned gateway — a
+    standard-tier `app` model stuck with a fat collector linger under a
+    tight tier SLO — driven through the SAME chaos-shifted workload
+    twice: once left alone (static arm), once with the AutoTuner armed
+    at bench cadence (tuned arm). Mid-run a batch-tier `bulk` flood
+    starts (the workload shift); the flight recorder is on in BOTH arms
+    so phase attribution (queue_wait dominating the standard tier)
+    routes the tuner's hill-climb at the linger knob through the same
+    reconfigure seam POST /config drives. Headline is the post-shift
+    standard-tier p99 speedup (static/tuned, client-observed); extras
+    carry both p99s, the verdict, the tuner's move/freeze counters and
+    its decision trail — the same rows appended to
+    autotune_ledger.jsonl, so the BENCH row is auditable against the
+    control loop's own ledger."""
+    import queue as _queue
+    import threading
+    from deeplearning4j_tpu import (Adam, DenseLayer, InputType,
+                                    MultiLayerNetwork,
+                                    NeuralNetConfiguration, OutputLayer,
+                                    WeightInit)
+    from deeplearning4j_tpu.optimize.metrics import registry as _reg
+    from deeplearning4j_tpu.serving import (ServingGateway, SLOMonitor,
+                                            TierShedError)
+    from deeplearning4j_tpu.serving import flight_recorder
+
+    def head(seed):
+        conf = (NeuralNetConfiguration.builder().seed(seed)
+                .updater(Adam(1e-3)).weight_init(WeightInit.XAVIER).list()
+                .layer(DenseLayer(n_out=64, activation="relu"))
+                .layer(OutputLayer(n_out=10, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(32))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(0)
+    payloads = [rng.standard_normal((1, 32)).astype(np.float32)
+                for _ in range(16)]
+
+    def build():
+        gw = ServingGateway(latency_window_s=window_s)
+        gw.add_model("app", head(7), batch_limit=8, queue_limit=1024,
+                     batch_timeout_ms=linger_ms, tier="standard")
+        gw.add_model("bulk", head(11), batch_limit=16, queue_limit=1024,
+                     batch_timeout_ms=linger_ms, tier="batch")
+        gw.pool.reconfigure_scheduler(
+            tier_slo_ms={"standard": standard_slo_ms, "batch": 500.0})
+        gw.warmup()
+        return gw
+
+    def drive(gw):
+        """The chaos-shifted load: pinned app clients throughout, the
+        bulk flood joining at shift_s. Returns (sorted post-shift app
+        latencies in ms, total app requests served)."""
+        errors: "_queue.Queue" = _queue.Queue()
+        samples = [[] for _ in range(clients)]
+        gw.predict("app", payloads[0])  # seed EWMAs, unmeasured
+        gw.predict("bulk", payloads[0])
+        _beat(repeat=1, phase="measure")
+        start = time.perf_counter()
+        shift_at = start + shift_s
+        end = start + run_s
+
+        def app_client(ci):
+            try:
+                i = 0
+                while time.perf_counter() < end:
+                    t0 = time.perf_counter()
+                    try:
+                        gw.predict("app", payloads[(ci + i) % len(payloads)])
+                        samples[ci].append(
+                            (t0, (time.perf_counter() - t0) * 1e3))
+                    except TierShedError:
+                        pass
+                    i += 1
+            except Exception as e:
+                errors.put(e)
+
+        def bulk_client(ci):
+            try:
+                i = 0
+                while time.perf_counter() < shift_at:
+                    time.sleep(0.02)
+                while time.perf_counter() < end:
+                    try:
+                        gw.predict("bulk", payloads[i % len(payloads)])
+                    except TierShedError:
+                        time.sleep(0.001)  # typed backoff, keep flooding
+                    i += 1
+            except Exception as e:
+                errors.put(e)
+
+        ts = [threading.Thread(target=app_client, args=(i,))
+              for i in range(clients)]
+        ts += [threading.Thread(target=bulk_client, args=(i,))
+               for i in range(bulk_clients)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        if not errors.empty():
+            raise errors.get()
+        post = sorted(ms for cell in samples
+                      for (t0, ms) in cell if t0 >= shift_at)
+        return post, sum(len(cell) for cell in samples)
+
+    def p99(vals):
+        if not vals:
+            return 0.0
+        return vals[min(len(vals) - 1, int(round(0.99 * (len(vals) - 1))))]
+
+    flight_recorder.enable()
+    try:
+        # --- static arm: the mis-tuned config left standing ------------
+        gw = build()
+        static_post, static_served = drive(gw)
+        gw.pool.shutdown()
+
+        # --- tuned arm: same config + the control loop at fast cadence -
+        gw = build()
+        tuner = gw.attach_tuner(
+            monitor=SLOMonitor(gw.pool, window_s=window_s, min_samples=3),
+            interval_s=interval_s, settle_ticks=1,
+            breach_freeze_factor=5.0, freeze_cooldown_s=2.0)
+        tuned_post, tuned_served = drive(gw)
+        tuner.stop()
+        trail = tuner.trail(200)
+        tuned_final_linger = gw.pool.get("app").engine.batch_timeout_ms
+        gw.pool.shutdown()
+    finally:
+        flight_recorder.disable()
+
+    sp99, tp99 = p99(static_post), p99(tuned_post)
+    reg = _reg()
+    moves = {oc: int(reg.counter("serving_tuner_moves_total")
+                     .total(outcome=oc))
+             for oc in ("applied", "kept", "reverted", "neutral",
+                        "refused")}
+    # The decision trail rides the extras compacted (the full evidence
+    # rows live in autotune_ledger.jsonl, keyed by the same seq).
+    decision_trail = [
+        {k: e[k] for k in ("seq", "kind", "knob", "outcome", "old",
+                           "new", "reason") if k in e}
+        for e in trail][-24:]
+    return sp99 / max(tp99, 1e-9), {
+        "clients": clients,
+        "bulk_clients": bulk_clients,
+        "run_s": run_s,
+        "shift_s": shift_s,
+        "standard_slo_ms": standard_slo_ms,
+        "static_linger_ms": linger_ms,
+        "tuned_final_linger_ms": round(float(tuned_final_linger), 3),
+        "static_p99_ms": round(sp99, 2),
+        "tuned_p99_ms": round(tp99, 2),
+        "tuner_win": bool(tp99 < sp99),
+        "post_shift_requests": {"static": len(static_post),
+                                "tuned": len(tuned_post)},
+        "served_requests": {"static": static_served,
+                            "tuned": tuned_served},
+        "tuner_moves": moves,
+        "tuner_reverts": int(reg.counter(
+            "serving_tuner_reverts_total").total()),
+        "tuner_freezes": int(reg.counter(
+            "serving_tuner_freezes_total").total()),
+        "tuner_frozen": int(reg.gauge("serving_tuner_frozen").value()),
+        "decision_trail": decision_trail,
+    }
+
+
 def bench_serving_quant(clients=4, requests_per_client=40, batch_limit=16,
                         n_in=1024, hidden=2048):
     """Quantized-serving A/B (docs/serving.md §quantized): ONE gateway,
@@ -1303,6 +1477,9 @@ _DEGRADED_KW = {
     "serving": dict(clients=2, requests_per_client=20),
     "serving_multimodel": dict(clients=2, requests_per_client=20,
                                batch_limit=8),
+    "serving_autotune": dict(run_s=2.5, shift_s=1.0, clients=2,
+                             bulk_clients=1, interval_s=0.2,
+                             window_s=1.0),
     "serving_quant": dict(clients=2, requests_per_client=10,
                           n_in=64, hidden=128),
     "quant_matmul_ab": dict(batch=4, k=128, n=128, repeats=5),
@@ -1390,6 +1567,9 @@ def _dispatch_once(workload: str, arg, kw):
         rps, ext = bench_serving_multimodel(**kw)
         return ("serving_multimodel_requests_per_sec", rps,
                 "requests/sec", ext)
+    if workload == "serving_autotune":
+        spd, ext = bench_serving_autotune(**kw)
+        return ("serving_autotune_p99_speedup", spd, "x", ext)
     if workload == "serving_quant":
         rps, ext = bench_serving_quant(**kw)
         return ("serving_quant_int8_requests_per_sec", rps,
@@ -1436,7 +1616,8 @@ def _dispatch_once(workload: str, arg, kw):
         "attention_ab [seq] | attention_packed [bucket] | alexnet | "
         "alexnet_pallaslrn | lenet | lenet_tiny | lstm | w2v [scale] | "
         "etl | lenet_hostfed | serving | serving_multimodel | "
-        "serving_quant | quant_matmul_ab | check [metric...] | report")
+        "serving_autotune | serving_quant | quant_matmul_ab | "
+        "check [metric...] | report")
 
 
 def _register_metric_families():
@@ -1449,6 +1630,7 @@ def _register_metric_families():
     from deeplearning4j_tpu.ops import pooling as pooling_ops
     from deeplearning4j_tpu.optimize import resilience, scoreboard
     from deeplearning4j_tpu.parallel import cluster_health
+    from deeplearning4j_tpu.serving import autotuner as serving_autotuner
     from deeplearning4j_tpu.serving import breaker as serving_breaker
     from deeplearning4j_tpu.serving import flight_recorder
     from deeplearning4j_tpu.serving import model_pool as serving_pool
@@ -1464,6 +1646,7 @@ def _register_metric_families():
     serving_breaker.register_metrics()
     serving_scheduler.register_metrics()
     serving_pool.register_metrics()
+    serving_autotuner.register_metrics()
     flight_recorder.register_metrics()
     cluster_health.register_metrics()
     pooling_ops.register_metrics()
@@ -1739,7 +1922,9 @@ def main():
               "quant_speedup_int8", "quant_speedup_bf16",
               "max_drift_int8", "max_drift_bf16",
               "quant_matmul_impl", "winner", "dispatch_verdict",
-              "int8_arms_bit_exact", "native_vnni"):
+              "int8_arms_bit_exact", "native_vnni",
+              "static_p99_ms", "tuned_p99_ms", "tuner_win",
+              "decision_trail", "tuner_moves", "tuner_freezes"):
         if k in med:
             ledger_extras[k] = med[k]
     _append_ledger(scoreboard.make_row(

@@ -26,6 +26,7 @@ loop. Nothing here fences the device.
 """
 from __future__ import annotations
 
+import collections
 import resource
 import sys
 import threading
@@ -111,24 +112,34 @@ class _Family:
     def _touched(self) -> bool:
         return not self._children  # bare families always export
 
+    def items(self) -> List[Tuple[Dict[str, str], "_Family"]]:
+        """[(labels_dict, child)] snapshot including the bare child when
+        it exports — the scrape-side iteration surface the gateway's
+        percentile collector and the SLO monitor walk."""
+        return [(dict(key), child) for key, child in self._cells()]
+
     def value(self, **labels) -> float:
         child = self.labels(**labels)
         with self._lock:
             return child._value
 
-    def total(self) -> float:
+    def total(self, **labels) -> float:
         """Sum of this family's value across every label set (the
         label-blind aggregate bench extras and health summaries want:
         e.g. breaker transitions regardless of target state).
-        Histograms aggregate their observation counts."""
+        Histograms aggregate their observation counts. A label filter
+        (`total(outcome="canary_rejected")`) sums only the children
+        whose label set carries every given pair — the bare child never
+        matches a non-empty filter."""
+        want = {(k, str(v)) for k, v in labels.items()}
         with self._lock:
-            if isinstance(self, Histogram):
-                vals = [c._n for c in self._children.values()]
-                vals.append(self._n)
-            else:
-                vals = [c._value for c in self._children.values()]
-                vals.append(self._value)
-            return float(sum(vals))
+            cells = [((), self)] + list(self._children.items())
+            tot = 0.0
+            for key, c in cells:
+                if want and not want.issubset(set(key)):
+                    continue
+                tot += c._n if isinstance(self, Histogram) else c._value
+            return float(tot)
 
 
 class Counter(_Family):
@@ -172,9 +183,18 @@ DEFAULT_BUCKETS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
 
 class Histogram(_Family):
     """Cumulative-bucket histogram (Prometheus histogram exposition:
-    `_bucket{le=...}`, `_sum`, `_count`)."""
+    `_bucket{le=...}`, `_sum`, `_count`) plus a bounded ring of recent
+    (timestamp, value) observations for *windowed* quantiles — the
+    cumulative buckets answer "over the process lifetime", the ring
+    answers "over the last N seconds" (what an SLO verdict needs)."""
 
     kind = "histogram"
+
+    # Ring capacity per child: at 2048 the window math matches the
+    # recent-latency deques it replaced; beyond it the OLDEST
+    # observations drop first, so a saturated ring under-reports the
+    # window span, never the recency.
+    RING = 2048
 
     def __init__(self, name, help, lock,
                  buckets: Iterable[float] = DEFAULT_BUCKETS):
@@ -183,6 +203,7 @@ class Histogram(_Family):
         self._counts = [0] * (len(self.buckets) + 1)  # +inf tail
         self._sum = 0.0
         self._n = 0
+        self._ring: "collections.deque" = collections.deque(maxlen=self.RING)
         self._exemplar: Optional[Tuple[str, float]] = None
 
     def labels(self, **labels) -> "Histogram":
@@ -197,16 +218,54 @@ class Histogram(_Family):
                 self._children[key] = child
             return child
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, t: Optional[float] = None) -> None:
+        """Record one observation. `t` overrides the ring timestamp
+        (time.monotonic() by default) — the fake-clock seam windowed
+        tests inject through, paired with `now=` on quantile()."""
         v = float(value)
+        ts = time.monotonic() if t is None else float(t)
         with self._lock:
             self._sum += v
             self._n += 1
+            self._ring.append((ts, v))
             for i, b in enumerate(self.buckets):
                 if v <= b:
                     self._counts[i] += 1
                     return
             self._counts[-1] += 1
+
+    def window_values(self, window_s: Optional[float] = None,
+                      now: Optional[float] = None) -> List[float]:
+        """Observations from the last `window_s` seconds (ring-bounded;
+        None = everything still in the ring), oldest first. `now`
+        defaults to time.monotonic() — pass the same clock observe()
+        was stamped with when injecting a fake one. The window is
+        two-sided, (now - window_s, now]: an observation stamped AFTER
+        `now` is on a different clock (a fake-clock test sharing the
+        process-global registry with a real-clock reader) and must not
+        leak into this reader's view of "recent"."""
+        cutoff = None
+        if window_s is not None:
+            ref = time.monotonic() if now is None else float(now)
+            cutoff = (ref - float(window_s), ref)
+        with self._lock:
+            if cutoff is None:
+                return [v for _, v in self._ring]
+            return [v for ts, v in self._ring
+                    if cutoff[0] <= ts <= cutoff[1]]
+
+    def quantile(self, q: float, window_s: Optional[float] = None,
+                 now: Optional[float] = None) -> float:
+        """Nearest-rank quantile over the windowed ring (0.0 when no
+        observation lands in the window) — the ONE latency-percentile
+        definition the scrape gauges, /stats, and the SLO monitor all
+        share."""
+        vals = sorted(self.window_values(window_s, now=now))
+        if not vals:
+            return 0.0
+        qf = min(1.0, max(0.0, float(q)))
+        idx = min(len(vals) - 1, int(round(qf * (len(vals) - 1))))
+        return float(vals[idx])
 
     def exemplar(self, trace_id: str, value: float) -> None:
         """Attach the most recent exemplar observation (a request id the

@@ -569,6 +569,11 @@ _ROW_KIND_EXTRAS: Dict[str, Tuple[str, ...]] = {
                       "max_drift_int8", "max_drift_bf16"),
     "quant_matmul_ab": ("winner", "dispatch_verdict",
                         "int8_arms_bit_exact"),
+    # The self-tuning A/B (docs/observability.md §"The serving control
+    # loop"): a speedup without both arms' p99, the verdict, and the
+    # tuner's own decision trail is unauditable.
+    "serving_autotune": ("static_p99_ms", "tuned_p99_ms", "tuner_win",
+                         "decision_trail"),
 }
 
 

@@ -7,8 +7,11 @@ deeplearning4j-nearestneighbor-server, SURVEY.md §2.11), all on the
 shared utils/http_server core. The per-request flight recorder
 (serving/flight_recorder.py — phase-attributed tail latency,
 slow-request exemplars, GET /debug/requests + /trace) is exported as
-the `flight_recorder` submodule."""
-from . import flight_recorder
+the `flight_recorder` submodule; the serving control loop
+(serving/autotuner.py — windowed SLO verdicts + the auditable
+hill-climbing AutoTuner behind GET /debug/tuner) as `autotuner`."""
+from . import autotuner, flight_recorder
+from .autotuner import AutoTuner, Knob, SLOMonitor
 from .breaker import BreakerOpenError, CircuitBreaker
 from .flight_recorder import RequestTrace
 from .gateway import ServingGateway

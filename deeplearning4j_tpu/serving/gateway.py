@@ -39,14 +39,19 @@ is saturated — and per-tier latency rides
 ordinary models: `/predict` carries the member name, the entry's
 transform slices its columns out of the shared fused forward.
 
-Endpoints: POST /predict, POST /swap, POST /config (live tier/weight/
-packed-admission reconfiguration), GET /health, GET /models, GET /stats,
-GET /metrics (Prometheus exposition — scrape surface shared with
-UIServer, docs/observability.md), plus the flight-recorder surfaces
+Endpoints: POST /predict, POST /swap, POST /config (live
+reconfiguration: per-entry tier/weight/packed-admission/
+batch_timeout_ms plus scheduler-level quantum/shed_depth/
+starvation_budget/tier_slo_ms, typed 400s on unknown or invalid
+knobs), GET /health, GET /models, GET /stats, GET /metrics (Prometheus
+exposition — scrape surface shared with UIServer,
+docs/observability.md), plus the flight-recorder surfaces
 GET /debug/requests?model=&tier= (slow-request exemplars) and
 GET /trace (Chrome trace export of serving spans) — both 404 until
 `serving.flight_recorder.enable()` (or DL4JTPU_FLIGHT_RECORDER=1) arms
-the recorder. Metrics:
+the recorder — and GET /debug/tuner (the AutoTuner decision trail,
+404 until `attach_tuner()` arms the serving control loop,
+docs/observability.md §"The serving control loop"). Metrics:
 `serving_requests_total{model,status}`, `serving_admitted_total`,
 `serving_shed_total{model,reason}`, `serving_swaps_total{model,outcome,precision}`,
 `serving_queue_depth{model}`, `serving_batch_failures_total{model}`,
@@ -55,16 +60,17 @@ the recorder. Metrics:
 `serving_slo_breach_total{model,tier}` (always on — a transient SLO
 breach between scrapes is invisible to the p99 gauges),
 `serving_latency_ms{model}` histogram plus scrape-time
-`serving_latency_p50_ms`/`serving_latency_p99_ms` gauges, and — with
-the recorder enabled — `serving_phase_ms{model,tier,phase}`
-(docs/observability.md §"Request flight recorder").
+`serving_latency_p50_ms`/`serving_latency_p99_ms` gauges (computed
+from the histogram's windowed ring — ONE percentile definition shared
+with /stats and the SLO monitor), with the recorder enabled
+`serving_phase_ms{model,tier,phase}` (docs/observability.md §"Request
+flight recorder"), and with a tuner attached the `serving_tuner_*` /
+`serving_slo_verdict{tier}` families (serving/autotuner.py).
 Every request runs inside a `serve/request` tracing span.
 """
 from __future__ import annotations
 
-import collections
 import json
-import threading
 import time
 from typing import Any, Dict, Optional
 
@@ -107,12 +113,14 @@ class ServingGateway(JsonHttpServer):
     def __init__(self, pool: Optional[ModelPool] = None, *, port: int = 0,
                  pool_size: int = 8,
                  default_deadline_ms: Optional[float] = None,
-                 shed_headroom: float = 1.0):
+                 shed_headroom: float = 1.0,
+                 latency_window_s: float = 60.0):
         super().__init__(
             get_routes={"/health": self._health_route,
                         "/models": self._models_route,
                         "/stats": self._stats_route,
-                        "/debug/requests": self._debug_requests_route},
+                        "/debug/requests": self._debug_requests_route,
+                        "/debug/tuner": self._debug_tuner_route},
             post_routes={"/predict": self._predict_route,
                          "/swap": self._swap_route,
                          "/config": self._config_route},
@@ -124,13 +132,20 @@ class ServingGateway(JsonHttpServer):
         flight_recorder.maybe_enable_from_env()
         self.default_deadline_ms = default_deadline_ms
         self.shed_headroom = float(shed_headroom)
-        self._lat_lock = threading.Lock()
-        # Recent per-model latencies for p50/p99 (bounded: a gateway
-        # lives for days) — the registry histogram is the durable record.
-        self._latencies: Dict[str, collections.deque] = {}
-        # Per-TIER latency windows (only populated when the pool runs a
-        # DeviceScheduler — tier labels mean nothing without one).
-        self._tier_latencies: Dict[str, collections.deque] = {}
+        # ONE latency-percentile definition (docs/observability.md §"The
+        # serving control loop"): /stats, the scrape gauges, and the
+        # SLO monitor all read the serving_latency_ms histogram's
+        # windowed ring over this many recent seconds.
+        self.latency_window_s = float(latency_window_s)
+        # Window floor: the registry (and its histogram rings) is
+        # process-global but THIS gateway is not — observations stamped
+        # before it existed (a previous gateway in the same process)
+        # must never leak into its percentiles.
+        self._born = time.monotonic()
+        # AutoTuner attachment point (serving/autotuner.py). None by
+        # default: no monitor, no thread, no ledger — today's serving
+        # path bitwise.
+        self.tuner = None
         reg = registry()
         self._req_c = reg.counter(
             "serving_requests_total",
@@ -297,20 +312,6 @@ class ServingGateway(JsonHttpServer):
                     want_summary=_trace_sink is not None)
                 if _trace_sink is not None and summary is not None:
                     _trace_sink.append(summary)
-            if status == "ok":
-                with self._lat_lock:
-                    dq = self._latencies.get(name)
-                    if dq is None:
-                        dq = self._latencies.setdefault(
-                            name, collections.deque(maxlen=2048))
-                    dq.append(dur_ms)
-                    if tiered:
-                        tq = self._tier_latencies.get(entry.tier)
-                        if tq is None:
-                            tq = self._tier_latencies.setdefault(
-                                entry.tier,
-                                collections.deque(maxlen=2048))
-                        tq.append(dur_ms)
 
     def _tier_slo(self, tier: Optional[str]) -> Optional[float]:
         """The latency SLO a request of `tier` is judged against: the
@@ -323,22 +324,37 @@ class ServingGateway(JsonHttpServer):
         return DEFAULT_TIER_SLO_MS.get(tier)
 
     # ---------------------------------------------------------------- stats
+    def _windowed_latencies(self):
+        """([(model, sorted_vals)], [(tier, sorted_vals)]) from the
+        serving_latency_ms histogram rings over the last
+        `latency_window_s` seconds — the single percentile source
+        /stats, the scrape gauges, and the SLO monitor share (the
+        recent-latency deques this replaced had their own, subtly
+        different, definition)."""
+        now = time.monotonic()
+        w = min(self.latency_window_s, max(0.0, now - self._born))
+        items, titems = [], []
+        for labels, child in self._lat_h.items():
+            vals = child.window_values(w, now=now)
+            if not vals:
+                continue
+            if "model" in labels:
+                items.append((labels["model"], sorted(vals)))
+            elif "tier" in labels:
+                titems.append((labels["tier"], sorted(vals)))
+        return sorted(items), sorted(titems)
+
     def stats(self) -> Dict[str, Any]:
-        """Per-model {p50_ms, p99_ms, count} over the recent-latency
-        window plus the pool description (bench.py's serving row reads
+        """Per-model {p50_ms, p99_ms, count} over the windowed latency
+        ring plus the pool description (bench.py's serving row reads
         this)."""
         out: Dict[str, Any] = {"models": self.pool.describe()}
-        lat: Dict[str, Any] = {}
-        with self._lat_lock:
-            items = [(n, sorted(d)) for n, d in self._latencies.items()]
-        for name, vals in items:
-            lat[name] = {"p50_ms": round(_percentile(vals, 0.50), 3),
-                         "p99_ms": round(_percentile(vals, 0.99), 3),
-                         "count": len(vals)}
-        out["latency"] = lat
-        with self._lat_lock:
-            titems = [(t, sorted(d))
-                      for t, d in self._tier_latencies.items()]
+        items, titems = self._windowed_latencies()
+        out["latency"] = {
+            name: {"p50_ms": round(_percentile(vals, 0.50), 3),
+                   "p99_ms": round(_percentile(vals, 0.99), 3),
+                   "count": len(vals)}
+            for name, vals in items}
         if titems:
             out["tiers"] = {
                 t: {"p50_ms": round(_percentile(v, 0.50), 3),
@@ -352,10 +368,7 @@ class ServingGateway(JsonHttpServer):
                         "p50 gateway latency over the recent window")
         g99 = reg.gauge("serving_latency_p99_ms",
                         "p99 gateway latency over the recent window")
-        with self._lat_lock:
-            items = [(n, sorted(d)) for n, d in self._latencies.items()]
-            titems = [(t, sorted(d))
-                      for t, d in self._tier_latencies.items()]
+        items, titems = self._windowed_latencies()
         for name, vals in items:
             g50.labels(model=name).set(_percentile(vals, 0.50))
             g99.labels(model=name).set(_percentile(vals, 0.99))
@@ -368,11 +381,28 @@ class ServingGateway(JsonHttpServer):
                 tg.labels(tier=t).set(_percentile(vals, 0.99))
 
     # ------------------------------------------------------------ lifecycle
+    def attach_tuner(self, tuner=None, *, start: bool = True, **kw):
+        """Arm the serving control loop (serving/autotuner.py): attach
+        an AutoTuner over this gateway's pool — built from `kw`
+        (interval_s, ledger_path, knobs, monitor, ...) when none is
+        passed — and start its tick thread by default. Until this is
+        called the gateway runs the exact untuned path."""
+        from .autotuner import AutoTuner
+        if tuner is None:
+            tuner = AutoTuner(self.pool, **kw)
+        self.tuner = tuner
+        if start:
+            tuner.start()
+        return tuner
+
     def stop(self):
         """Graceful: finish in-flight HTTP handlers (JsonHttpServer),
-        then drain the engines (stragglers served, stranded callers
-        failed with ServerClosedError — never hung)."""
+        stop the tuner thread if one is attached, then drain the
+        engines (stragglers served, stranded callers failed with
+        ServerClosedError — never hung)."""
         super().stop()
+        if self.tuner is not None:
+            self.tuner.stop()
         self.pool.shutdown()
 
     # --------------------------------------------------------------- routes
@@ -473,30 +503,93 @@ class ServingGateway(JsonHttpServer):
         except SwapError as e:
             return 409, {"status": "swap_failed", "error": str(e)}
 
+    # Live-reconfigurable knobs POST /config accepts: per-entry
+    # (routed at req["model"]) and scheduler-level (no model needed).
+    _ENTRY_KNOBS = ("packed_admission", "pack_bucket", "tier", "weight",
+                    "batch_timeout_ms")
+    _SCHED_KNOBS = ("quantum", "shed_depth", "starvation_budget",
+                    "tier_slo_ms")
+
     def _config_route(self, req: dict):
-        """Live per-entry reconfiguration: packed admission (the PR-12
-        HTTP knob), tier, WFQ weight. Body: {"model": ...,
-        "packed_admission": bool?, "pack_bucket": int?, "tier": str?,
-        "weight": float?}. 409 on invalid combinations (unknown tier,
-        fused-group member)."""
-        name = req.get("model", "default")
-        kw = {}
-        if "packed_admission" in req:
-            kw["packed_admission"] = bool(req["packed_admission"])
-        if "pack_bucket" in req:
-            kw["pack_bucket"] = int(req["pack_bucket"])
-        if "tier" in req:
-            kw["tier"] = req["tier"]
-        if "weight" in req:
-            kw["weight"] = float(req["weight"])
-        if not kw:
+        """Live reconfiguration. Per-entry knobs (packed_admission /
+        pack_bucket / tier / weight / batch_timeout_ms) route at
+        req["model"]; scheduler-level knobs (quantum / shed_depth /
+        starvation_budget / tier_slo_ms) need no model and create the
+        shared scheduler on first use. Typed 400 on unknown knobs or
+        invalid values (reason: unknown_knob / invalid_value), 404 on
+        unknown model, 409 on invalid per-entry combinations (unknown
+        tier, fused-group member)."""
+        unknown = sorted(set(req) - set(self._ENTRY_KNOBS)
+                         - set(self._SCHED_KNOBS) - {"model"})
+        if unknown:
+            return 400, {"status": "error", "reason": "unknown_knob",
+                         "error": "unknown config knob(s): "
+                                  + ", ".join(unknown)}
+        try:
+            entry_kw: Dict[str, Any] = {}
+            if "packed_admission" in req:
+                entry_kw["packed_admission"] = bool(req["packed_admission"])
+            if "pack_bucket" in req:
+                entry_kw["pack_bucket"] = int(req["pack_bucket"])
+            if "tier" in req:
+                entry_kw["tier"] = req["tier"]
+            if "weight" in req:
+                entry_kw["weight"] = float(req["weight"])
+            if "batch_timeout_ms" in req:
+                entry_kw["batch_timeout_ms"] = float(req["batch_timeout_ms"])
+            sched_kw: Dict[str, Any] = {}
+            if "quantum" in req:
+                sched_kw["quantum"] = float(req["quantum"])
+            if "shed_depth" in req:
+                sched_kw["shed_depth"] = int(req["shed_depth"])
+            if "starvation_budget" in req:
+                sched_kw["starvation_budget"] = int(
+                    req["starvation_budget"])
+            if "tier_slo_ms" in req:
+                slo = req["tier_slo_ms"]
+                if not isinstance(slo, dict):
+                    raise TypeError("tier_slo_ms must be a "
+                                    "{tier: slo_ms} object")
+                sched_kw["tier_slo_ms"] = {
+                    str(t): float(v) for t, v in slo.items()}
+        except (TypeError, ValueError) as e:
+            return 400, {"status": "error", "reason": "invalid_value",
+                         "error": str(e)}
+        if not entry_kw and not sched_kw:
             return 400, {"status": "error",
                          "error": "no reconfigurable knob in request "
                                   "(packed_admission/pack_bucket/tier/"
-                                  "weight)"}
-        try:
-            return 200, self.pool.reconfigure(name, **kw)
-        except KeyError as e:
-            return 404, {"status": "error", "error": str(e)}
-        except ValueError as e:
-            return 409, {"status": "error", "error": str(e)}
+                                  "weight/batch_timeout_ms/quantum/"
+                                  "shed_depth/starvation_budget/"
+                                  "tier_slo_ms)"}
+        out: Dict[str, Any] = {"status": "ok"}
+        if sched_kw:
+            try:
+                out["scheduler"] = self.pool.reconfigure_scheduler(
+                    **sched_kw)
+            except ValueError as e:
+                return 400, {"status": "error", "reason": "invalid_value",
+                             "error": str(e)}
+        if entry_kw:
+            name = req.get("model", "default")
+            try:
+                out.update(self.pool.reconfigure(name, **entry_kw))
+            except KeyError as e:
+                return 404, {"status": "error", "error": str(e)}
+            except ValueError as e:
+                return 409, {"status": "error", "error": str(e)}
+        return 200, out
+
+    def _debug_tuner_route(self, _):
+        """GET /debug/tuner — the AutoTuner decision trail: state,
+        knob table with guardrails, known-good snapshot, and the last
+        ledger rows. 404 until attach_tuner() arms the control loop
+        (flight-recorder route pattern)."""
+        if self.tuner is None:
+            return 404, {"status": "error", "enabled": False,
+                         "error": "no AutoTuner attached — "
+                                  "gateway.attach_tuner() arms the "
+                                  "serving control loop"}
+        body = self.tuner.describe()
+        body.update({"status": "ok", "enabled": True})
+        return 200, body

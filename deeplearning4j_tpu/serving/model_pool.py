@@ -181,6 +181,7 @@ class ModelEntry:
             "total_batch_failures": self.engine.total_batch_failures,
             "tier": self.tier,
             "weight": self.weight,
+            "batch_timeout_ms": float(self.engine.batch_timeout_ms),
             "precision": self.precision,
         }
         if self.group is not None:
@@ -457,14 +458,20 @@ class ModelPool:
                     packed_admission: Optional[bool] = None,
                     pack_bucket: Optional[int] = None,
                     tier: Optional[str] = None,
-                    weight: Optional[float] = None) -> Dict[str, Any]:
+                    weight: Optional[float] = None,
+                    batch_timeout_ms: Optional[float] = None
+                    ) -> Dict[str, Any]:
         """Live per-entry reconfiguration (the gateway's POST /config
-        surface). Tier/weight changes re-rank the entry in the shared
-        scheduler (creating it on first use); packed-admission changes
-        rebuild the entry's engine with the new admission mode — the
-        old engine drains its queue, the new one is warmed to the old
-        bucket set first, and no queued request is dropped. Fused-group
-        members cannot be reconfigured in place (eject_member first)."""
+        surface and the AutoTuner's per-entry actuator). Tier/weight
+        changes re-rank the entry in the shared scheduler (creating it
+        on first use); `batch_timeout_ms` (the collector linger) is a
+        plain live set — the collector thread reads it every iteration,
+        so the next coalescing window already honors it, no engine
+        rebuild, no recompile; packed-admission changes rebuild the
+        entry's engine with the new admission mode — the old engine
+        drains its queue, the new one is warmed to the old bucket set
+        first, and no queued request is dropped. Fused-group members
+        cannot be reconfigured in place (eject_member first)."""
         entry = self.get(name)
         if entry.group is not None:
             raise ValueError(
@@ -472,6 +479,12 @@ class ModelPool:
                 f"{entry.group.name!r}; eject_member() it before "
                 "reconfiguring")
         changed: List[str] = []
+        if batch_timeout_ms is not None:
+            bt = float(batch_timeout_ms)
+            if bt < 0:
+                raise ValueError("batch_timeout_ms must be >= 0")
+            entry.engine.batch_timeout_ms = bt
+            changed.append("batch_timeout_ms")
         if tier is not None or weight is not None:
             if tier is not None:
                 if tier not in TIER_VALUES:
@@ -513,6 +526,14 @@ class ModelPool:
         out = entry.describe()
         out["reconfigured"] = changed
         return out
+
+    def reconfigure_scheduler(self, **knobs) -> Dict[str, Any]:
+        """Scheduler-level live reconfiguration (quantum / shed_depth /
+        starvation_budget / tier_slo_ms — DeviceScheduler.reconfigure),
+        creating the shared scheduler on first use so an operator can
+        set SLOs before any tiered entry exists. Raises ValueError on
+        invalid values, mutating nothing."""
+        return self._ensure_scheduler().reconfigure(**knobs)
 
     def get(self, name: str) -> ModelEntry:
         with self._lock:

@@ -193,6 +193,59 @@ class DeviceScheduler:
         with self._cv:
             self._entries.pop(name, None)
 
+    # ------------------------------------------------------- reconfigure
+    def reconfigure(self, *, quantum: Optional[float] = None,
+                    shed_depth: Optional[int] = None,
+                    starvation_budget: Optional[int] = None,
+                    tier_slo_ms: Optional[Dict[str, float]] = None
+                    ) -> Dict[str, object]:
+        """Live scheduler-level reconfiguration (the gateway's
+        POST /config scheduler knobs and the AutoTuner's actuator).
+        Validates BEFORE mutating — an invalid call changes nothing —
+        and re-exports the serving_tier_slo_ms gauges on SLO changes.
+        Raises ValueError on invalid values (unknown tier, non-positive
+        quantum/budget/depth)."""
+        if quantum is not None and float(quantum) <= 0:
+            raise ValueError("quantum must be > 0")
+        if shed_depth is not None and int(shed_depth) < 1:
+            raise ValueError("shed_depth must be >= 1")
+        if starvation_budget is not None and int(starvation_budget) < 1:
+            raise ValueError("starvation_budget must be >= 1")
+        slo_update: Dict[str, float] = {}
+        if tier_slo_ms:
+            for t, v in dict(tier_slo_ms).items():
+                if t not in TIER_VALUES:
+                    raise ValueError(
+                        f"unknown tier {t!r} in tier_slo_ms; one of {TIERS}")
+                if float(v) <= 0:
+                    raise ValueError(f"tier_slo_ms[{t!r}] must be > 0")
+                slo_update[t] = float(v)
+        with self._cv:
+            if quantum is not None:
+                self.quantum = float(quantum)
+            if shed_depth is not None:
+                self.shed_depth = int(shed_depth)
+            if starvation_budget is not None:
+                self.starvation_budget = int(starvation_budget)
+            if slo_update:
+                self.tier_slo_ms.update(slo_update)
+        if slo_update:
+            slo_g = registry().gauge(
+                "serving_tier_slo_ms",
+                "Configured p99 latency SLO per priority tier")
+            for t, v in slo_update.items():
+                slo_g.labels(tier=t).set(v)
+        return self.config()
+
+    def config(self) -> Dict[str, object]:
+        """The scheduler-level knob values (the reconfigure surface's
+        current state; per-entry state lives in describe())."""
+        with self._cv:
+            return {"quantum": self.quantum,
+                    "shed_depth": self.shed_depth,
+                    "starvation_budget": self.starvation_budget,
+                    "tier_slo_ms": dict(self.tier_slo_ms)}
+
     def names(self) -> List[str]:
         with self._cv:
             return list(self._entries)

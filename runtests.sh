@@ -85,6 +85,15 @@ JAX_PLATFORMS=cpu python tests/smoke_multimodel.py
 # the device phase. Hard signal.alarm guard.
 JAX_PLATFORMS=cpu python tests/smoke_request_trace.py
 
+# Serving control-loop smoke (docs/observability.md §"The serving
+# control loop"): a live gateway with a deliberately mis-tuned linger
+# under a tight tier SLO, AutoTuner at fast cadence, a batch-tier
+# flood joining mid-run — >= 1 schema-valid ledgered move, zero
+# guardrail violations, the linger measurably tightened, /debug/tuner
+# rendering the decision trail over HTTP, and no freeze on a clean
+# run. Hard signal.alarm guard.
+JAX_PLATFORMS=cpu python tests/smoke_autotuner.py
+
 # Cluster-health smoke (docs/robustness.md §cluster-health): fake-clock
 # watchdog transitions (PeerLost/Desync), typed barrier timeout, and a
 # real SIGTERM'd child writing a grace checkpoint then resuming
