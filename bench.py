@@ -1350,6 +1350,131 @@ def bench_serving_quant(clients=4, requests_per_client=40, batch_limit=16,
     }
 
 
+def bench_serving_decode(clients=6, prompts_per_client=4,
+                         max_new_tokens=48, vocab=256, layers=4,
+                         heads=4, head_dim=32, ff=512, max_context=256,
+                         max_decode_batch=8):
+    """Autoregressive decode A/B (docs/serving.md §decode): the SAME
+    causal LM decodes greedily through two arms. The KV-cached arm is
+    the real serving path — concurrent clients POST-shaped generate()
+    calls through the gateway's DecodeEngine, prompts admitted via the
+    packed prefill, then token-granularity continuous batching over the
+    paged KV cache (steps are O(1) in sequence length). The naive arm
+    re-runs the FULL sequence through the prefill executable for every
+    token (O(t) per token, no cache, sequential) — the cost model the
+    decode plane exists to beat. Headline is the KV-cached arm's
+    tokens/sec; extras carry both arms, the speedup ratio, the engine's
+    inter-token p99, and the paged cache's utilization receipt (real
+    tokens / allocated block capacity). Honesty rule: both arms decode
+    identical prompt sets with identical greedy semantics — token
+    parity between the arms is asserted, so the speedup can never come
+    from the cached arm doing different (or wrong) work."""
+    import queue as _queue
+    import threading
+    from deeplearning4j_tpu.optimize.metrics import registry as _registry
+    from deeplearning4j_tpu.serving import ServingGateway
+    from deeplearning4j_tpu.serving import decode as serving_decode
+
+    model = serving_decode.TransformerDecoder(
+        vocab=vocab, layers=layers, heads=heads, head_dim=head_dim,
+        ff=ff, max_context=max_context, seed=7)
+    gw = ServingGateway()
+    pack_bucket = min(128, max_context)
+    entry = gw.add_decode_model(
+        "lm", model, max_decode_batch=max_decode_batch,
+        pack_bucket=pack_bucket,
+        kv_block_tokens=16,
+        kv_max_blocks=max(64, (max_context // 16) * max_decode_batch * 2))
+    gw.warmup()
+    cache = entry.engine.adapter.cache
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, vocab, size=ln).tolist()
+               for ln in rng.integers(4, 33, size=clients
+                                      * prompts_per_client)]
+
+    errors: "_queue.Queue" = _queue.Queue()
+    results: Dict[int, list] = {}
+    kv_util = [0.0]
+    stop_sampling = threading.Event()
+
+    def sample_kv():
+        while not stop_sampling.is_set():
+            kv_util[0] = max(kv_util[0], cache.utilization())
+            time.sleep(0.005)
+
+    def client(ci):
+        try:
+            for j in range(prompts_per_client):
+                pi = ci * prompts_per_client + j
+                results[pi] = gw.generate(
+                    "lm", prompts[pi], max_new_tokens=max_new_tokens)
+        except Exception as e:
+            errors.put(e)
+
+    # unmeasured seeding pass so the clock starts hot on both arms
+    gw.generate("lm", prompts[0], max_new_tokens=2)
+    _beat(repeat=1, phase="measure")
+    sampler = threading.Thread(target=sample_kv, daemon=True)
+    sampler.start()
+    t0 = time.perf_counter()
+    ts = [threading.Thread(target=client, args=(i,))
+          for i in range(clients)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    dt = time.perf_counter() - t0
+    stop_sampling.set()
+    sampler.join(timeout=1.0)
+    if not errors.empty():
+        raise errors.get()
+    total_tokens = clients * prompts_per_client * max_new_tokens
+    cached_tps = total_tokens / dt
+
+    # engine-side inter-token tail over the measured window
+    itl_vals = []
+    for labels, child in _registry().histogram(
+            "serving_inter_token_ms",
+            "Wall time between a request's consecutive tokens "
+            "(step + between-step scheduling)").items():
+        if labels.get("model") == "lm":
+            itl_vals = sorted(child.window_values(dt + 5.0))
+    itl_p99 = itl_vals[min(len(itl_vals) - 1,
+                           int(len(itl_vals) * 0.99))] if itl_vals else 0.0
+
+    # naive arm: sequential full-recompute decode of the same prompts
+    # (a subset scaled back up — O(t) per token makes the full set
+    # prohibitively slow, which is the point)
+    naive_n = min(len(prompts), max(2, clients))
+    _beat(repeat=2, phase="measure")
+    t0 = time.perf_counter()
+    naive_out = [serving_decode.naive_generate(
+        model, prompts[i], max_new_tokens, pad_to=pack_bucket)
+        for i in range(naive_n)]
+    naive_dt = time.perf_counter() - t0
+    naive_tps = naive_n * max_new_tokens / max(naive_dt, 1e-9)
+    for i in range(naive_n):
+        if results.get(i) != naive_out[i]:
+            raise RuntimeError(
+                f"decode arms diverged on prompt {i}: the speedup would "
+                "be measuring different work")
+    gw.pool.shutdown()
+    return cached_tps, {
+        "clients": clients,
+        "model": (f"decoder L{layers} H{heads}x{head_dim} "
+                  f"ctx{max_context}"),
+        "max_new_tokens": max_new_tokens,
+        "tokens_per_sec": round(cached_tps, 1),
+        "naive_tokens_per_sec": round(naive_tps, 1),
+        "kv_cache_speedup": round(cached_tps / max(naive_tps, 1e-9), 2),
+        "inter_token_p99_ms": round(itl_p99, 3),
+        "kv_utilization": round(kv_util[0], 4),
+        "kv_block_tokens": cache.block_tokens,
+        "kv_max_blocks": cache.max_blocks,
+        "arms_token_exact": True,
+    }
+
+
 def bench_quant_matmul_ab(batch=8, k=1024, n=1024, repeats=50):
     """Op-level int8-matmul A/B (docs/perf_pallas.md honesty rule): time
     every standing arm — XLA `dot_general(preferred_element_type=s32)`,
@@ -1482,6 +1607,10 @@ _DEGRADED_KW = {
                              window_s=1.0),
     "serving_quant": dict(clients=2, requests_per_client=10,
                           n_in=64, hidden=128),
+    "serving_decode": dict(clients=2, prompts_per_client=2,
+                           max_new_tokens=12, layers=2, heads=2,
+                           head_dim=8, ff=64, max_context=64,
+                           max_decode_batch=4),
     "quant_matmul_ab": dict(batch=4, k=128, n=128, repeats=5),
 }
 
@@ -1574,6 +1703,9 @@ def _dispatch_once(workload: str, arg, kw):
         rps, ext = bench_serving_quant(**kw)
         return ("serving_quant_int8_requests_per_sec", rps,
                 "requests/sec", ext)
+    if workload == "serving_decode":
+        tps, ext = bench_serving_decode(**kw)
+        return ("serving_decode_tokens_per_sec", tps, "tokens/sec", ext)
     if workload == "quant_matmul_ab":
         spd, ext = bench_quant_matmul_ab(**kw)
         return ("quant_matmul_ab_int8_speedup_vs_fp32", spd,
@@ -1616,8 +1748,8 @@ def _dispatch_once(workload: str, arg, kw):
         "attention_ab [seq] | attention_packed [bucket] | alexnet | "
         "alexnet_pallaslrn | lenet | lenet_tiny | lstm | w2v [scale] | "
         "etl | lenet_hostfed | serving | serving_multimodel | "
-        "serving_autotune | serving_quant | quant_matmul_ab | "
-        "check [metric...] | report")
+        "serving_autotune | serving_quant | serving_decode | "
+        "quant_matmul_ab | check [metric...] | report")
 
 
 def _register_metric_families():
@@ -1632,6 +1764,7 @@ def _register_metric_families():
     from deeplearning4j_tpu.parallel import cluster_health
     from deeplearning4j_tpu.serving import autotuner as serving_autotuner
     from deeplearning4j_tpu.serving import breaker as serving_breaker
+    from deeplearning4j_tpu.serving import decode as serving_decode
     from deeplearning4j_tpu.serving import flight_recorder
     from deeplearning4j_tpu.serving import model_pool as serving_pool
     from deeplearning4j_tpu.serving import scheduler as serving_scheduler
@@ -1644,6 +1777,7 @@ def _register_metric_families():
     # families (bench_rows_total{status} et al).
     resilience.register_metrics()
     serving_breaker.register_metrics()
+    serving_decode.register_metrics()
     serving_scheduler.register_metrics()
     serving_pool.register_metrics()
     serving_autotuner.register_metrics()

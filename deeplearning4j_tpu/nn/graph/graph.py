@@ -31,7 +31,7 @@ from ...utils import params as param_utils
 from ..conf.builders import BackpropType
 from ..conf.graph_conf import ComputationGraphConfiguration
 from ..graph.vertices import LastTimeStepVertex
-from ..multilayer import _regularization_score
+from ..multilayer import RnnStateMismatchError, _regularization_score
 from ..updaters import normalize_layer_gradients
 from ..stepping import DeviceIterationMixin
 from ..layers.recurrent import RECURRENT_CARRY_KEYS
@@ -806,10 +806,15 @@ class ComputationGraph(DeviceIterationMixin):
         if self._rnn_carry is not None:
             for carry in self._rnn_carry.values():
                 if "h" in carry and carry["h"].shape[0] != batch:
-                    raise ValueError(
+                    stored = carry["h"].shape[0]
+                    # Typed error + explicit reset (same contract as
+                    # MultiLayerNetwork.rnn_time_step): never leave a
+                    # stale carry to poison the next streaming caller.
+                    self._rnn_carry = None
+                    raise RnnStateMismatchError(
                         f"rnn_time_step batch size {batch} != stored state "
-                        f"batch size {carry['h'].shape[0]}; call "
-                        "rnn_clear_previous_state() between sequences")
+                        f"batch size {stored}; stored recurrent state has "
+                        "been reset")
         self._seed_recurrent_states(batch)
         outs, new_state = self._rnn_step_fn(
             self.params_tree, self._merged_state(), inputs)

@@ -73,6 +73,14 @@ def _regularization_score(layers, params) -> Array:
     return total
 
 
+class RnnStateMismatchError(ValueError):
+    """rnn_time_step was called with a batch size that does not match
+    the stored recurrent carry. The carry is RESET before this raises:
+    a failed streaming request must not poison state for the next
+    caller (the pre-fix behaviour left the stale per-layer carry
+    behind, silently corrupting the following sequence)."""
+
+
 class MultiLayerNetwork(DeviceIterationMixin):
     def __init__(self, conf: MultiLayerConfiguration):
         self.conf = conf
@@ -1060,10 +1068,15 @@ class MultiLayerNetwork(DeviceIterationMixin):
         if self._rnn_carry is not None:
             for carry in self._rnn_carry:
                 if "h" in carry and carry["h"].shape[0] != x.shape[0]:
-                    raise ValueError(
+                    stored = carry["h"].shape[0]
+                    # Typed error + explicit reset: leaving the stale
+                    # carry behind would corrupt the NEXT streaming
+                    # caller (stored-state poisoning).
+                    self._rnn_carry = None
+                    raise RnnStateMismatchError(
                         f"rnn_time_step batch size {x.shape[0]} != stored "
-                        f"state batch size {carry['h'].shape[0]}; call "
-                        "rnn_clear_previous_state() between sequences")
+                        f"state batch size {stored}; stored recurrent "
+                        "state has been reset")
         self._seed_recurrent_states(x.shape[0])
         out, new_state = self._rnn_step_fn(
             self.params_tree, self._merged_state(), x)

@@ -563,3 +563,54 @@ def flash_attention_available() -> bool:
     """One-time eager compile probe (kernel_probe rationale applies: a
     traced first call must not poison the cache)."""
     return kernel_probe("flash_attention", _flash_probe)
+
+
+def decode_attention(q, k, v, cache_len, *, impl: str = "auto",
+                     interpret: bool = False):
+    """Single-query-row attention against a growing KV cache.
+
+    The decode-loop variant of `flash_attention`: each batch row holds
+    ONE new query token attending to its first `cache_len[i]` cached
+    KV positions. Inputs:
+
+      q          [batch, 1, heads, head_dim]  — this step's query
+      k, v       [batch, t_kv, heads, head_dim] — bucketed cache view
+                 (t_kv is a pow2 bucket; tail rows beyond cache_len are
+                 garbage and masked out here)
+      cache_len  [batch] int32 — valid prefix length per row, >= 1
+                 (the row INCLUDING the current token, already
+                 scattered into k/v at position cache_len-1)
+
+    Returns [batch, 1, heads, head_dim].
+
+    `impl="flash"` routes through the flash kernel with q_block=1
+    (pick_kernel_block(1, ·) == 1, so the tq=1 row tiles legally);
+    `impl="dense"` is the einsum reference; `impl="auto"` picks flash
+    when the geometry gate and the one-time probe both pass. No
+    backward: decode is inference-only, and the wrapper is jit-friendly
+    (cache_len is a traced operand, so one executable serves every
+    fill level of a given bucket).
+    """
+    b, tq, hh, d = q.shape
+    if tq != 1:
+        raise ValueError(f"decode_attention takes one query row, got {tq}")
+    tk = k.shape[1]
+    cache_len = jnp.asarray(cache_len, jnp.int32)
+    valid = jnp.arange(tk, dtype=jnp.int32)[None, :] < cache_len[:, None]
+    if impl not in ("auto", "flash", "dense"):
+        raise ValueError(f"unknown decode_attention impl {impl!r}")
+    use_flash = impl == "flash" or (
+        impl == "auto" and flash_attention_supported(1, tk, d)
+        and flash_attention_available())
+    if use_flash:
+        return flash_attention(q, k, v, key_mask=valid,
+                               interpret=interpret)
+    # Dense reference arm: f32 accumulate, NEG for masked positions.
+    # A fully-masked row cannot occur (cache_len >= 1 by contract).
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores / math.sqrt(d)
+    scores = jnp.where(valid[:, None, None, :], scores, NEG)
+    w = jax.nn.softmax(scores, axis=-1)
+    o = jnp.einsum("bhqk,bkhd->bqhd", w.astype(v.dtype), v)
+    return o.astype(q.dtype)

@@ -574,6 +574,13 @@ _ROW_KIND_EXTRAS: Dict[str, Tuple[str, ...]] = {
     # tuner's own decision trail is unauditable.
     "serving_autotune": ("static_p99_ms", "tuned_p99_ms", "tuner_win",
                          "decision_trail"),
+    # The decode A/B (docs/serving.md §decode): a tokens/sec headline
+    # without the naive-recompute arm, the speedup ratio, the
+    # inter-token tail, and the KV-cache utilization receipt doesn't
+    # prove the paged cache earned its complexity.
+    "serving_decode": ("tokens_per_sec", "naive_tokens_per_sec",
+                       "kv_cache_speedup", "inter_token_p99_ms",
+                       "kv_utilization"),
 }
 
 

@@ -8,7 +8,8 @@ from .cluster_health import (BarrierTimeoutError, ClusterDesyncError,
                              ClusterHealthError, ClusterHealthMonitor,
                              GraceCheckpointed, HealthConfig, PeerLostError,
                              timed_collective)
-from .inference import (DeadlineExceededError, InferenceMode,
+from .inference import (DeadlineExceededError, DecodeStepError,
+                        InferenceMode, KVCacheExhaustedError,
                         ParallelInference, QueueFullError, ServerClosedError)
 from .multihost import (CheckpointManager, MultiHostRunner,
                         StepCheckpointManager)

@@ -69,6 +69,19 @@ class DeadlineExceededError(RuntimeError):
     awareness)."""
 
 
+class DecodeStepError(BatchExecutionError):
+    """One iteration-level decode step failed for the requests riding
+    it: the victims get this typed wrapper (KV blocks freed), decode
+    batchmates keep generating on the next step. Subclass of
+    BatchExecutionError so breaker/gateway accounting is inherited."""
+
+
+class KVCacheExhaustedError(QueueFullError):
+    """The paged KV cache has no free blocks for this admission or
+    growth step — the decode plane's backpressure signal. Subclass of
+    QueueFullError so the gateway maps it to a shed (429), not a 500."""
+
+
 class _Request:
     __slots__ = ("x", "event", "result", "error", "deadline", "transform",
                  "tag", "trace")

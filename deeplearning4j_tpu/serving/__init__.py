@@ -9,10 +9,16 @@ shared utils/http_server core. The per-request flight recorder
 slow-request exemplars, GET /debug/requests + /trace) is exported as
 the `flight_recorder` submodule; the serving control loop
 (serving/autotuner.py — windowed SLO verdicts + the auditable
-hill-climbing AutoTuner behind GET /debug/tuner) as `autotuner`."""
-from . import autotuner, flight_recorder
+hill-climbing AutoTuner behind GET /debug/tuner) as `autotuner`. The
+autoregressive decode plane (serving/decode.py — token-granularity
+continuous batching over a paged KV cache, POST /generate,
+docs/serving.md §decode) is exported as `decode`."""
+from . import autotuner, decode, flight_recorder
 from .autotuner import AutoTuner, Knob, SLOMonitor
 from .breaker import BreakerOpenError, CircuitBreaker
+from .decode import (DecodeEngine, PagedKVCache, RecurrentAdapter,
+                     TransformerAdapter, TransformerDecoder,
+                     naive_generate)
 from .flight_recorder import RequestTrace
 from .gateway import ServingGateway
 from .keras_server import KerasBackendServer

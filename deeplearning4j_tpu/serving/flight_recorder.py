@@ -35,7 +35,13 @@ Phase taxonomy (docs/observability.md §"Request flight recorder"):
   sched_wait  engine lock + DeviceScheduler slot wait (incl. swap pause)
   dispatch    slot grant → forward call (host-side submit bookkeeping)
   device      the forward itself + recorder's np.asarray result fence
+  prefill     decode only: packed segment-masked prompt forward + KV fill
+  decode_step decode only: the iteration-level token loop (per-step marks
+              aggregate — the phase sum stays cut-point exact)
   unpack      per-request scatter/unslice + member transform
+
+One-shot requests walk ONESHOT_PHASES; decode requests route device
+time through `prefill`/`decode_step` instead of `device`.
 
 `device` opens at the forward CALL, not at a mid-forward fence: on an
 async backend the enqueue cost belongs with the computation it enqueues,
@@ -55,16 +61,23 @@ from ..optimize import tracing
 from ..optimize.metrics import registry
 
 __all__ = [
-    "RequestTrace", "PHASES", "enable", "disable", "is_enabled", "clear",
-    "new_trace", "complete", "exemplars", "register_metrics",
-    "maybe_enable_from_env", "DEFAULT_EXEMPLAR_RING", "ENV_FLAG",
+    "RequestTrace", "PHASES", "ONESHOT_PHASES", "enable", "disable",
+    "is_enabled", "clear", "new_trace", "complete", "exemplars",
+    "register_metrics", "maybe_enable_from_env", "DEFAULT_EXEMPLAR_RING",
+    "ENV_FLAG",
 ]
 
-#: The seven phases every fully-served request decomposes into, in path
-#: order. Error/shed paths legitimately stop early (a breaker fast-fail
-#: has only `admission`).
+#: The full phase taxonomy in path order. Error/shed paths legitimately
+#: stop early (a breaker fast-fail has only `admission`); one-shot
+#: requests never mark `prefill`/`decode_step` (see ONESHOT_PHASES) and
+#: decode requests never mark the one-shot `pack`..`device` window.
 PHASES = ("admission", "queue_wait", "pack", "sched_wait", "dispatch",
-          "device", "unpack")
+          "device", "prefill", "decode_step", "unpack")
+
+#: The seven phases every fully-served ONE-SHOT request decomposes into
+#: — what `ParallelInference.output()` walks end to end.
+ONESHOT_PHASES = ("admission", "queue_wait", "pack", "sched_wait",
+                  "dispatch", "device", "unpack")
 
 DEFAULT_EXEMPLAR_RING = 64
 ENV_FLAG = "DL4JTPU_FLIGHT_RECORDER"

@@ -39,7 +39,16 @@ is saturated — and per-tier latency rides
 ordinary models: `/predict` carries the member name, the entry's
 transform slices its columns out of the shared fused forward.
 
-Endpoints: POST /predict, POST /swap, POST /config (live
+Generative entries (docs/serving.md §decode): a model registered via
+`add_decode_model` serves POST /generate through a DecodeEngine —
+token-granularity continuous batching over a paged KV cache — behind
+the SAME admission sequence (breaker → tier shed → deadline estimate)
+and the same typed error surface, plus two decode-specific statuses:
+429 `queue_full` when the KV cache itself is exhausted
+(KVCacheExhaustedError) and 500 `batch_failed` for a mid-generation
+step failure (DecodeStepError — batchmates keep generating).
+
+Endpoints: POST /predict, POST /generate, POST /swap, POST /config (live
 reconfiguration: per-entry tier/weight/packed-admission/
 batch_timeout_ms plus scheduler-level quantum/shed_depth/
 starvation_budget/tier_slo_ms, typed 400s on unknown or invalid
@@ -122,6 +131,7 @@ class ServingGateway(JsonHttpServer):
                         "/debug/requests": self._debug_requests_route,
                         "/debug/tuner": self._debug_tuner_route},
             post_routes={"/predict": self._predict_route,
+                         "/generate": self._generate_route,
                          "/swap": self._swap_route,
                          "/config": self._config_route},
             raw_get_routes={"/trace": self._trace_route},
@@ -170,6 +180,12 @@ class ServingGateway(JsonHttpServer):
     def add_model(self, name: str, model, **kw):
         """pool.add passthrough (see ModelPool.add for knobs)."""
         return self.pool.add(name, model, **kw)
+
+    def add_decode_model(self, name: str, model, **kw):
+        """pool.add_decode passthrough: register a generative entry
+        behind a DecodeEngine, served via generate()/POST /generate
+        (see ModelPool.add_decode for knobs)."""
+        return self.pool.add_decode(name, model, **kw)
 
     def add_fused_group(self, group_name: str, members, **kw):
         """pool.add_fused_group passthrough: N same-geometry models
@@ -304,6 +320,108 @@ class ServingGateway(JsonHttpServer):
                 if "precision" not in tr.ctx:
                     # fast-fail paths skip the admitted-path stamp; the
                     # exemplar ring must label precision consistently
+                    tr.ctx["precision"] = entry.precision
+                if entry.breaker is not None:
+                    tr.ctx["breaker"] = entry.breaker.state
+                summary = flight_recorder.complete(
+                    tr, status, dur_ms, slo_ms,
+                    want_summary=_trace_sink is not None)
+                if _trace_sink is not None and summary is not None:
+                    _trace_sink.append(summary)
+
+    # ------------------------------------------------------------- generate
+    def generate(self, name: str, prompt, *,
+                 max_new_tokens: int = 32,
+                 deadline_ms: Optional[float] = None,
+                 _trace_sink: Optional[list] = None):
+        """In-process decode entry point (POST /generate is the thin
+        wrapper): run `prompt` through `name`'s DecodeEngine — admitted
+        between decode steps, riding the token-granularity continuous
+        batch — and return the generated sequence (token-id list for
+        the transformer arm, [steps, features] array for the stream
+        arm).
+
+        The admission sequence is predict()'s, verbatim: breaker
+        fast-fail, tier shed, EWMA deadline estimate, then the engine.
+        Raises the same typed taxonomy plus DecodeStepError (a
+        mid-generation step failure — KV freed, batchmates unharmed)
+        and KVCacheExhaustedError (KV backpressure, a QueueFullError
+        subtype). The flight-recorder timeline routes device time
+        through the `prefill`/`decode_step` phases, with
+        `tokens_generated`/`kv_blocks` in the exemplar ctx."""
+        entry = self.pool.get(name)
+        t0 = time.perf_counter()
+        status = "error"
+        tr = flight_recorder.new_trace(name, entry.tier)
+        try:
+            if deadline_ms is None:
+                deadline_ms = self.default_deadline_ms
+            deadline = None if deadline_ms is None else \
+                time.monotonic() + float(deadline_ms) / 1000.0
+            with tracing.span("serve/generate", cat="serve", model=name):
+                br = entry.breaker
+                if br is not None and not br.allow():
+                    status = "breaker_open"
+                    raise BreakerOpenError(
+                        f"model {name!r} circuit breaker is "
+                        f"{br.state} — fast-failing without queuing")
+                sch = self.pool.scheduler
+                if sch is not None:
+                    sname = entry.engine.sched_name or name
+                    shed_reason = sch.should_shed(sname)
+                    if shed_reason is not None:
+                        self._shed_c.labels(model=name,
+                                            reason=shed_reason).inc()
+                        status = "shed"
+                        raise TierShedError(
+                            f"model {name!r} (tier {entry.tier!r}) shed: "
+                            "a higher tier's backlog saturates the "
+                            "shared device budget")
+                if deadline is not None:
+                    est = entry.engine.estimate_wait_s() * self.shed_headroom
+                    if time.monotonic() + est > deadline:
+                        self._shed_c.labels(model=name,
+                                            reason="admission").inc()
+                        status = "shed"
+                        raise DeadlineExceededError(
+                            f"estimated wait {est * 1000:.1f}ms cannot "
+                            f"meet deadline {deadline_ms}ms — shed at "
+                            "admission")
+                self._admit_c.labels(model=name).inc()
+                if tr is not None:
+                    tr.mark("admission")
+                    tr.ctx["precision"] = entry.precision
+                try:
+                    out = entry.engine.generate(
+                        prompt, max_new_tokens=max_new_tokens,
+                        deadline=deadline, trace=tr)
+                except QueueFullError:
+                    # KVCacheExhaustedError lands here too (subclass) —
+                    # both are backpressure, both 429 at the route.
+                    self._shed_c.labels(model=name,
+                                        reason="queue_full").inc()
+                    status = "shed"
+                    raise
+                except DeadlineExceededError:
+                    status = "shed"
+                    raise
+            status = "ok"
+            return out
+        finally:
+            dur_ms = (time.perf_counter() - t0) * 1000.0
+            self._req_c.labels(model=name, status=status).inc()
+            self._lat_h.labels(model=name).observe(dur_ms)
+            tiered = self.pool.scheduler is not None
+            if tiered:
+                self._lat_h.labels(tier=entry.tier).observe(dur_ms)
+            slo_ms = self._tier_slo(entry.tier)
+            if slo_ms is not None and dur_ms > slo_ms:
+                self._slo_breach_c.labels(model=name,
+                                          tier=entry.tier).inc()
+            if tr is not None:
+                if not tr.marks:
+                    tr.mark("admission")
+                if "precision" not in tr.ctx:
                     tr.ctx["precision"] = entry.precision
                 if entry.breaker is not None:
                     tr.ctx["breaker"] = entry.breaker.state
@@ -485,6 +603,60 @@ class ServingGateway(JsonHttpServer):
         resp = {"status": "ok", "model": name,
                 "version": entry.version.get("file", "initial"),
                 "predictions": np.asarray(out).tolist()}
+        if sink:
+            resp["trace"] = sink[0]
+        return 200, resp
+
+    def _generate_route(self, req: dict):
+        """POST /generate {"model", "prompt", "max_new_tokens",
+        "deadline_ms"} — the decode twin of /predict with the same
+        typed status chain. A ValueError from prompt validation (wrong
+        shape, out-of-vocab tokens, exceeds max_context) is the
+        client's fault: typed 400."""
+        name = req.get("model", "default")
+        if "prompt" not in req:
+            return 400, {"status": "error", "reason": "bad_prompt",
+                         "error": "request body needs a 'prompt' field"}
+        deadline_ms = req.get("deadline_ms")
+        sink = [] if flight_recorder.is_enabled() else None
+        try:
+            out = self.generate(
+                name, req["prompt"],
+                max_new_tokens=int(req.get("max_new_tokens", 32)),
+                deadline_ms=deadline_ms, _trace_sink=sink)
+        except KeyError as e:
+            return 404, {"status": "error", "error": str(e)}
+        except ValueError as e:
+            return 400, {"status": "error", "reason": "bad_prompt",
+                         "error": str(e)}
+        except BreakerOpenError as e:
+            return 503, {"status": "unavailable", "reason": "breaker_open",
+                         "error": str(e)}
+        except TierShedError as e:
+            return 503, {"status": "shed", "reason": "tier_shed",
+                         "error": str(e)}
+        except QueueFullError as e:
+            # KVCacheExhaustedError inherits this arm: KV backpressure
+            # is a retryable 429, never a 500.
+            return 429, {"status": "shed", "reason": "queue_full",
+                         "error": str(e)}
+        except DeadlineExceededError as e:
+            return 503, {"status": "shed", "reason": "deadline",
+                         "error": str(e)}
+        except NonFiniteOutputError as e:
+            return 500, {"status": "error", "reason": "nonfinite",
+                         "error": str(e)}
+        except BatchExecutionError as e:
+            # DecodeStepError inherits this arm: a failed step is a
+            # server-side 500 with the victim's KV already freed.
+            return 500, {"status": "error", "reason": "batch_failed",
+                         "error": str(e)}
+        except ServerClosedError as e:
+            return 503, {"status": "error", "error": str(e)}
+        entry = self.pool.get(name)
+        resp = {"status": "ok", "model": name,
+                "version": entry.version.get("file", "initial"),
+                "tokens": np.asarray(out).tolist()}
         if sink:
             resp["trace"] = sink[0]
         return 200, resp

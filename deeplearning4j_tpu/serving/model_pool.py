@@ -288,9 +288,11 @@ class ModelPool:
             rows_c.labels(model=_name).inc(rows)
             fill_h.labels(model=_name).observe(rows)
             _breaker.record_success()
-            if _entry.golden_batch is None and reqs:
+            if (_entry.golden_batch is None and reqs
+                    and getattr(reqs[0], "x", None) is not None):
                 # Retain a slice of real traffic as the swap canary
-                # input (first served request, at most 4 rows).
+                # input (first served request, at most 4 rows). Decode
+                # requests carry prompts, not feature rows — no capture.
                 _entry.golden_batch = np.asarray(reqs[0].x[:4]).copy()
 
         def _on_batch_error(exc, n_requests, _name=name, _breaker=breaker):
@@ -362,6 +364,88 @@ class ModelPool:
         self._wire_hooks(entry)
         with self._lock:
             if name in self._entries:
+                raise ValueError(f"model {name!r} already registered")
+            self._entries[name] = entry
+        _set_precision_gauge(name, entry.precision)
+        if (self.scheduler is not None or tier != "standard"
+                or weight != 1.0):
+            self._ensure_scheduler()
+            self._sched_register(entry)
+        return entry
+
+    def add_decode(self, name: str, model, *, checkpoints=None,
+                   max_decode_batch: int = 8, queue_limit: int = 64,
+                   max_context: Optional[int] = None,
+                   pack_bucket: int = 64,
+                   kv_block_tokens: int = 16,
+                   kv_max_blocks: int = 256,
+                   feature_dim: Optional[int] = None,
+                   check_finite: bool = True,
+                   breaker: Optional[CircuitBreaker] = None,
+                   breaker_threshold: int = 5,
+                   breaker_reset_s: float = 30.0,
+                   tier: str = "standard",
+                   weight: float = 1.0) -> ModelEntry:
+        """Register a GENERATIVE entry under `name` behind a
+        DecodeEngine (serving/decode.py): token-granularity continuous
+        batching over a paged KV cache, served through POST /generate.
+
+        The model family picks the adapter: a
+        :class:`~.decode.TransformerDecoder` decodes through the
+        packed-prefill + paged-KV token arm (`pack_bucket`,
+        `kv_block_tokens`, `kv_max_blocks` size that plane); a streaming
+        network exposing ``rnn_time_step`` decodes through the
+        recurrent arm (`feature_dim` is its per-step input width —
+        required, and the net's ``n_out`` must equal it, since the
+        output feeds back as the next step's input).
+
+        Breaker / tier / weight / checkpoint knobs mean exactly what
+        they mean on :meth:`add` — the entry rides the same routing
+        table, swap protocol (the engine's ``swap_warm`` re-warms the
+        decode signature grid inside the pause window), and describe()
+        surface."""
+        from .decode import (DecodeEngine, PagedKVCache, RecurrentAdapter,
+                             TransformerAdapter, TransformerDecoder)
+        if tier not in TIER_VALUES:
+            raise ValueError(f"unknown tier {tier!r}; one of "
+                             f"{tuple(TIER_VALUES)}")
+        if isinstance(checkpoints, (str, os.PathLike)):
+            from ..optimize.resilience import CheckpointManager
+            checkpoints = CheckpointManager(checkpoints)
+        if isinstance(model, TransformerDecoder):
+            cache = PagedKVCache(
+                layers=model.n_layers, heads=model.heads,
+                head_dim=model.head_dim,
+                block_tokens=kv_block_tokens, max_blocks=kv_max_blocks)
+            adapter = TransformerAdapter(model, cache,
+                                         pack_bucket=pack_bucket,
+                                         check_finite=check_finite)
+        elif hasattr(model, "rnn_time_step"):
+            if feature_dim is None:
+                raise ValueError(
+                    "recurrent decode entries need feature_dim= (the "
+                    "net's per-step input width)")
+            adapter = RecurrentAdapter(model, feature_dim=feature_dim,
+                                       check_finite=check_finite)
+        else:
+            raise ValueError(
+                f"model {type(model).__name__} fits neither decode arm: "
+                "need a TransformerDecoder or a streaming net with "
+                "rnn_time_step")
+        engine = DecodeEngine(adapter, name=name,
+                              max_decode_batch=max_decode_batch,
+                              queue_limit=queue_limit,
+                              max_context=max_context)
+        if breaker is None:
+            breaker = CircuitBreaker(name,
+                                     failure_threshold=breaker_threshold,
+                                     reset_timeout_s=breaker_reset_s)
+        entry = ModelEntry(name, model, engine, checkpoints,
+                           breaker=breaker, tier=tier, weight=weight)
+        self._wire_hooks(entry)
+        with self._lock:
+            if name in self._entries:
+                engine.shutdown()
                 raise ValueError(f"model {name!r} already registered")
             self._entries[name] = entry
         _set_precision_gauge(name, entry.precision)
@@ -692,10 +776,15 @@ class ModelPool:
                 try:
                     # Warm the new params through the EXISTING AOT
                     # executables (warmup() re-precompile is a no-op per
-                    # stored signature: zero compile events).
+                    # stored signature: zero compile events). Decode
+                    # engines warm their own (row × KV view) grid.
+                    swap_warm = getattr(entry.engine, "swap_warm", None)
                     for b in buckets:
                         faults.fire("swap.warm")
-                        model.warmup(b, time_steps=time_steps)
+                        if swap_warm is not None:
+                            swap_warm(b)
+                        else:
+                            model.warmup(b, time_steps=time_steps)
                     # Canary gate: the new params must produce all-finite
                     # outputs on the golden batch (and, with
                     # canary_max_drift set, stay within the drift budget

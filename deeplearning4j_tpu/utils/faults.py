@@ -64,6 +64,11 @@ Points used by the serving stack (docs/serving.md):
     swap.warm          each per-bucket warm forward inside the
                        pause-assign-warm swap window (fires the rollback
                        path when armed)
+    serve.decode_step  each iteration-level decode step in DecodeEngine,
+                       before the step forward dispatches — an armed
+                       failure fails the riding requests typed
+                       (DecodeStepError), frees their KV blocks, and
+                       leaves decode batchmates generating
 
 Environment arming: ``DL4JTPU_FAULT_<POINT>`` with dots mapped to
 underscores, e.g. ``DL4JTPU_FAULT_CHECKPOINT_WRITE="kill:3"`` — this is

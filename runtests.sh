@@ -111,6 +111,15 @@ JAX_PLATFORMS=cpu python tests/smoke_cluster_health.py
 # fp32 tree bitwise. Canary both ways, one gate.
 JAX_PLATFORMS=cpu python tests/smoke_quant_swap.py
 
+# Decode smoke (docs/serving.md §decode): a gateway serving BOTH decode
+# families (paged-KV transformer + streaming LSTM) under concurrent
+# mixed-length HTTP /generate traffic — every response token-exact vs
+# the naive full-recompute reference, typed 400/404 chain, a
+# serve.decode_step chaos window isolated to exactly one rider with KV
+# blocks drained, ZERO compiles after warmup, decode metric families
+# scraped. Hard signal.alarm guard.
+JAX_PLATFORMS=cpu python tests/smoke_decode.py
+
 # Bench scoreboard smoke (docs/observability.md §bench-scoreboard): wedge
 # a real bench child mid-measurement via the bench.child delay fault and
 # assert the fail-safe plane holds — exit 0, the artifact parses with

@@ -1,0 +1,214 @@
+"""Decode-plane smoke: the zero-compile / typed-outcome acceptance
+check for continuous batching, end to end over real HTTP
+(docs/serving.md §decode).
+
+Builds a gateway with BOTH decode families — a causal TransformerDecoder
+("lm", paged-KV token arm) and a streaming LSTM ("stream",
+rnn_time_step arm) — warms the full signature grid, then asserts:
+
+* concurrent mixed-length /generate traffic returns 200 with tokens
+  EXACTLY matching the naive full-recompute reference (the KV cache is
+  an optimization, never an approximation),
+* ZERO XLA compiles after warmup (prefill packing + every pow2 row/KV
+  bucket ride the warmed executables),
+* the typed error chain over HTTP: missing prompt -> 400 bad_prompt,
+  out-of-vocab -> 400, unknown model -> 404,
+* chaos: a serve.decode_step fault (batch attempt + first solo retry)
+  kills EXACTLY one rider with a 500 batch_failed while its batchmate
+  finishes every token; KV blocks drain to zero and the engine keeps
+  serving afterwards,
+* the decode metric families reach the Prometheus scrape surface.
+
+Run by runtests.sh as a separate step (no test_ prefix on purpose —
+this is a concurrency/e2e smoke, not a pytest unit). Exits nonzero on
+any failed expectation.
+
+Usage: JAX_PLATFORMS=cpu python tests/smoke_decode.py
+"""
+import json
+import os
+import signal
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deeplearning4j_tpu import (LSTM, InputType,  # noqa: E402
+                                MultiLayerNetwork, NeuralNetConfiguration,
+                                RnnOutputLayer, Sgd)
+from deeplearning4j_tpu.optimize.telemetry import CompilationTracker  # noqa: E402
+from deeplearning4j_tpu.serving import ServingGateway  # noqa: E402
+from deeplearning4j_tpu.serving.decode import (TransformerDecoder,  # noqa: E402
+                                               naive_generate)
+from deeplearning4j_tpu.utils import faults  # noqa: E402
+
+REQUIRED_FAMILIES = (
+    "serving_decode_tokens_total", "serving_decode_steps_total",
+    "serving_decode_prefills_total", "serving_inter_token_ms_bucket",
+    "serving_kv_blocks_in_use", "serving_kv_utilization",
+)
+
+PACK = 32
+
+
+def post(url, payload):
+    req = urllib.request.Request(
+        url, json.dumps(payload).encode(),
+        {"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def make_stream_net():
+    conf = (NeuralNetConfiguration.builder().seed(3).updater(Sgd(0.1))
+            .list()
+            .layer(LSTM(n_out=6, activation="tanh"))
+            .layer(RnnOutputLayer(n_out=4, activation="identity",
+                                  loss="mse"))
+            .set_input_type(InputType.recurrent(4)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def main() -> int:
+    signal.alarm(420)  # hard ceiling: a hung decode loop must not wedge CI
+    failures = []
+
+    lm = TransformerDecoder(vocab=64, layers=2, heads=2, head_dim=8,
+                            ff=64, max_context=64, seed=0)
+    gw = ServingGateway()
+    gw.add_decode_model("lm", lm, pack_bucket=PACK, kv_block_tokens=8,
+                        kv_max_blocks=64, max_decode_batch=4)
+    gw.add_decode_model("stream", make_stream_net(), feature_dim=4,
+                        max_decode_batch=4)
+    gw.warmup()
+    lm_cache = gw.pool.get("lm").engine.adapter.cache
+
+    # Naive full-recompute references, computed OUTSIDE the tracker
+    # window — only the gateway's own work is compile-silent-checked.
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(0, 64, n).tolist()
+               for n in (3, 9, 17, 5, 12, 7)]
+    want = [naive_generate(lm, p, 12, pad_to=PACK) for p in prompts]
+
+    statuses, errors = [], []
+
+    def lm_client(i):
+        try:
+            code, body = post(gw.url + "/generate",
+                              {"model": "lm", "prompt": prompts[i],
+                               "max_new_tokens": 12})
+            ok = code == 200 and body.get("tokens") == want[i]
+            statuses.append((code, body.get("status"), ok))
+        except Exception as e:
+            errors.append(e)
+
+    def stream_client(i):
+        x = np.random.default_rng(100 + i).standard_normal(
+            (2 + i, 4)).astype(np.float32)
+        try:
+            code, body = post(gw.url + "/generate",
+                              {"model": "stream", "prompt": x.tolist(),
+                               "max_new_tokens": 6})
+            shape = np.asarray(body.get("tokens")).shape
+            statuses.append((code, body.get("status"), shape == (6, 4)))
+        except Exception as e:
+            errors.append(e)
+
+    with gw, CompilationTracker() as trk:
+        ts = ([threading.Thread(target=lm_client, args=(i,))
+               for i in range(len(prompts))]
+              + [threading.Thread(target=stream_client, args=(i,))
+                 for i in range(2)])
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+
+        bad = [s for s in statuses if s[:2] != (200, "ok") or not s[2]]
+        if bad or len(statuses) != len(prompts) + 2:
+            failures.append(f"steady traffic: {len(bad)} bad of "
+                            f"{len(statuses)} (want {len(prompts) + 2} "
+                            f"200/ok/exact): {bad[:5]}")
+
+        # ---- typed error chain over HTTP
+        code, body = post(gw.url + "/generate", {"model": "lm"})
+        if (code, body.get("reason")) != (400, "bad_prompt"):
+            failures.append(f"missing prompt: want 400/bad_prompt, "
+                            f"got {code}/{body.get('reason')}")
+        code, body = post(gw.url + "/generate",
+                          {"model": "lm", "prompt": [1, 999]})
+        if (code, body.get("reason")) != (400, "bad_prompt"):
+            failures.append(f"out-of-vocab: want 400/bad_prompt, "
+                            f"got {code}/{body.get('reason')}")
+        code, _ = post(gw.url + "/generate",
+                       {"model": "nope", "prompt": [1]})
+        if code != 404:
+            failures.append(f"unknown model: want 404, got {code}")
+
+        # ---- chaos: batch step + first solo retry fail -> exactly one
+        # rider dies typed, the batchmate finishes every token
+        chaos = []
+
+        def chaos_client(i):
+            code, body = post(gw.url + "/generate",
+                              {"model": "lm", "prompt": prompts[i],
+                               "max_new_tokens": 12})
+            chaos.append((code, body.get("reason"),
+                          body.get("tokens") == want[i]))
+
+        with faults.injected("serve.decode_step", "fail:3,4"):
+            cts = [threading.Thread(target=chaos_client, args=(i,))
+                   for i in range(2)]
+            for t in cts:
+                t.start()
+            for t in cts:
+                t.join(timeout=120)
+        died = [c for c in chaos if c[0] == 500]
+        lived = [c for c in chaos if c[0] == 200]
+        if not (len(died) == 1 and died[0][1] == "batch_failed"
+                and len(lived) == 1 and lived[0][2]):
+            failures.append(f"chaos: want one 500/batch_failed + one "
+                            f"exact 200, got {chaos}")
+        if lm_cache.blocks_in_use() != 0:
+            failures.append(f"KV blocks leaked after chaos: "
+                            f"{lm_cache.blocks_in_use()} in use")
+
+        # engine keeps serving after the fault window
+        code, body = post(gw.url + "/generate",
+                          {"model": "lm", "prompt": prompts[0],
+                           "max_new_tokens": 12})
+        if code != 200 or body.get("tokens") != want[0]:
+            failures.append(f"post-chaos generate broken: {code}")
+
+        with urllib.request.urlopen(gw.url + "/metrics") as r:
+            metrics_text = r.read().decode()
+
+    if errors:
+        failures.append(f"{len(errors)} client(s) raised: {errors[:3]}")
+    if trk.count != 0:
+        failures.append(f"{trk.count} XLA compile(s) after warmup — "
+                        "steady-state decode must compile nothing")
+    for fam in REQUIRED_FAMILIES:
+        if fam not in metrics_text:
+            failures.append(f"metric family {fam} missing from /metrics")
+
+    if failures:
+        for f in failures:
+            print(f"SMOKE FAIL: {f}", file=sys.stderr)
+        return 1
+    print(f"decode smoke OK: {len(prompts)} transformer + 2 stream "
+          f"requests token-exact over HTTP, typed 400/404 chain, chaos "
+          f"isolated to one rider, 0 compiles after warmup, all "
+          f"{len(REQUIRED_FAMILIES)} decode metric families scraped")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -59,7 +59,7 @@ BUCKET = 16
 # exemplar this smoke may produce is the chaos-delayed request
 TIER_SLO_MS = {"critical": 2000.0, "standard": 2000.0, "batch": 8000.0}
 CHAOS_DELAY_MS = 2400  # > the critical SLO -> guaranteed exemplar
-PHASES = list(flight_recorder.PHASES)
+PHASES = list(flight_recorder.ONESHOT_PHASES)
 
 
 def _alarm(_sig, _frm):

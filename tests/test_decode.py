@@ -1,0 +1,322 @@
+"""Decode-plane tests (docs/serving.md §decode): paged KV cache
+arithmetic, decode_attention parity, adapter packing/validation, the
+typed rnn_time_step state-reset contract, scoreboard row-kind schema,
+and (slow) engine end-to-end parity / chaos isolation."""
+import threading
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (LSTM, ComputationGraph, InputType,
+                                MultiLayerNetwork, NeuralNetConfiguration,
+                                RnnOutputLayer, Sgd)
+from deeplearning4j_tpu.data.padding import next_pow2_bucket
+from deeplearning4j_tpu.nn.multilayer import RnnStateMismatchError
+from deeplearning4j_tpu.ops.flash_attention import decode_attention
+from deeplearning4j_tpu.optimize.scoreboard import _validate_row_kind
+from deeplearning4j_tpu.optimize.telemetry import CompilationTracker
+from deeplearning4j_tpu.parallel.inference import (DecodeStepError,
+                                                   KVCacheExhaustedError)
+from deeplearning4j_tpu.serving.decode import (DecodeEngine, PagedKVCache,
+                                               RecurrentAdapter,
+                                               TransformerAdapter,
+                                               TransformerDecoder,
+                                               naive_generate)
+from deeplearning4j_tpu.utils import faults
+
+
+def _cache(**kw):
+    kw.setdefault("layers", 2)
+    kw.setdefault("heads", 2)
+    kw.setdefault("head_dim", 4)
+    return PagedKVCache(**kw)
+
+
+class TestPagedKVCache:
+    def test_block_arithmetic(self):
+        c = _cache(block_tokens=16, max_blocks=8)
+        assert c.block_tokens == 16
+        assert c.blocks_needed(1) == 1
+        assert c.blocks_needed(16) == 1
+        assert c.blocks_needed(17) == 2
+        # non-pow2 request is snapped through the ONE bucket rule
+        assert _cache(block_tokens=12, max_blocks=2).block_tokens == 16
+
+    def test_write_append_view_roundtrip(self):
+        c = _cache(block_tokens=4, max_blocks=8)
+        rng = np.random.default_rng(0)
+        k = rng.standard_normal((6, 2, 2, 4)).astype(np.float32)
+        v = rng.standard_normal((6, 2, 2, 4)).astype(np.float32)
+        c.write_prompt(7, k, v)  # 6 tokens -> 2 blocks
+        assert c.length(7) == 6 and c.blocks_of(7) == 2
+        kt = rng.standard_normal((2, 2, 4)).astype(np.float32)
+        vt = rng.standard_normal((2, 2, 4)).astype(np.float32)
+        c.append(7, kt, vt)  # token 7 spills into block 2
+        assert c.length(7) == 7 and c.blocks_of(7) == 2
+        kv, vv, lens = c.batch_view([7], 8)
+        assert lens.tolist() == [7]
+        np.testing.assert_array_equal(kv[0, :6], k)
+        np.testing.assert_array_equal(kv[0, 6], kt)
+        np.testing.assert_array_equal(vv[0, :6], v)
+        np.testing.assert_array_equal(vv[0, 6], vt)
+        np.testing.assert_array_equal(kv[0, 7:], 0)  # pad stays zero
+
+    def test_exhaustion_is_all_or_nothing(self):
+        c = _cache(block_tokens=4, max_blocks=2)
+        z = np.zeros((12, 2, 2, 4), np.float32)  # needs 3 > 2 blocks
+        with pytest.raises(KVCacheExhaustedError):
+            c.write_prompt(1, z, z)
+        assert c.blocks_in_use() == 0 and c.length(1) == 0
+        # a failed GROW leaves the existing table intact
+        c.write_prompt(2, z[:8], z[:8])
+        assert c.free_blocks() == 0
+        tok = np.zeros((2, 2, 4), np.float32)
+        with pytest.raises(KVCacheExhaustedError):
+            c.append(2, tok, tok)
+        assert c.length(2) == 8 and c.blocks_of(2) == 2
+
+    def test_free_is_idempotent(self):
+        c = _cache(block_tokens=4, max_blocks=4)
+        z = np.zeros((5, 2, 2, 4), np.float32)
+        c.write_prompt(3, z, z)
+        assert c.blocks_in_use() == 2
+        c.free(3)
+        c.free(3)  # second free is a no-op, not a double-return
+        assert c.blocks_in_use() == 0 and c.free_blocks() == 4
+
+    def test_batch_view_rejects_non_block_multiple(self):
+        c = _cache(block_tokens=4, max_blocks=4)
+        z = np.zeros((2, 2, 2, 4), np.float32)
+        c.write_prompt(1, z, z)
+        with pytest.raises(ValueError):
+            c.batch_view([1], 6)
+
+
+class TestDecodeAttention:
+    @pytest.mark.parametrize("tk", [8, 16])
+    def test_matches_masked_softmax_reference(self, tk):
+        rng = np.random.default_rng(1)
+        b, h, d = 3, 2, 8
+        q = rng.standard_normal((b, 1, h, d)).astype(np.float32)
+        k = rng.standard_normal((b, tk, h, d)).astype(np.float32)
+        v = rng.standard_normal((b, tk, h, d)).astype(np.float32)
+        lens = np.array([1, tk // 2, tk], np.int32)
+        out = np.asarray(decode_attention(q, k, v, lens))
+        assert out.shape == (b, 1, h, d)
+        for i in range(b):
+            n = lens[i]
+            for hh in range(h):
+                s = q[i, 0, hh] @ k[i, :n, hh].T / np.sqrt(d)
+                w = np.exp(s - s.max())
+                w /= w.sum()
+                np.testing.assert_allclose(out[i, 0, hh], w @ v[i, :n, hh],
+                                           rtol=1e-4, atol=1e-5)
+
+    def test_rejects_multi_query_rows(self):
+        z = np.zeros((1, 2, 1, 4), np.float32)
+        with pytest.raises(ValueError):
+            decode_attention(z, z, z, np.ones(1, np.int32))
+
+
+def _stream_net(n_in=4, seed=3):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(0.1))
+            .list()
+            .layer(LSTM(n_out=6, activation="tanh"))
+            .layer(RnnOutputLayer(n_out=n_in, activation="identity",
+                                  loss="mse"))
+            .set_input_type(InputType.recurrent(n_in)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+class TestRnnStateReset:
+    def test_mismatch_is_typed_and_resets_mln(self):
+        net = _stream_net()
+        rng = np.random.default_rng(0)
+        net.rnn_time_step(rng.standard_normal((2, 4)).astype(np.float32))
+        assert net._rnn_carry is not None
+        with pytest.raises(RnnStateMismatchError):
+            net.rnn_time_step(rng.standard_normal((3, 4)).astype(np.float32))
+        # the stale carry is GONE: the next caller starts clean instead
+        # of inheriting the poisoned state (the pre-fix behaviour)
+        assert net._rnn_carry is None
+        x = rng.standard_normal((3, 4)).astype(np.float32)
+        fresh = _stream_net()
+        np.testing.assert_allclose(net.rnn_time_step(x),
+                                   fresh.rnn_time_step(x), rtol=1e-6)
+
+    def test_mismatch_is_typed_and_resets_graph(self):
+        conf = (NeuralNetConfiguration.builder().seed(3).updater(Sgd(0.1))
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("lstm", LSTM(n_out=6, activation="tanh"), "in")
+                .add_layer("out", RnnOutputLayer(n_out=4,
+                                                 activation="identity",
+                                                 loss="mse"), "lstm")
+                .set_outputs("out")
+                .set_input_types(InputType.recurrent(4)).build())
+        g = ComputationGraph(conf).init()
+        rng = np.random.default_rng(1)
+        g.rnn_time_step(rng.standard_normal((2, 4)).astype(np.float32))
+        with pytest.raises(RnnStateMismatchError):
+            g.rnn_time_step(rng.standard_normal((5, 4)).astype(np.float32))
+        assert g._rnn_carry is None
+        g.rnn_time_step(rng.standard_normal((5, 4)).astype(np.float32))
+
+    def test_is_a_value_error(self):
+        # gateway maps ValueError -> 400; the typed subclass must ride it
+        assert issubclass(RnnStateMismatchError, ValueError)
+
+
+class TestTransformerAdapter:
+    def _adapter(self, pack_bucket=16, **cache_kw):
+        model = TransformerDecoder(vocab=32, layers=1, heads=2, head_dim=4,
+                                   ff=16, max_context=64)
+        cache_kw.setdefault("block_tokens", 4)
+        cache_kw.setdefault("max_blocks", 32)
+        cache = PagedKVCache(layers=1, heads=2, head_dim=4, **cache_kw)
+        return TransformerAdapter(model, cache, pack_bucket=pack_bucket)
+
+    def test_validate_prompt(self):
+        a = self._adapter()
+        np.testing.assert_array_equal(a.validate_prompt([1, 2, 3]),
+                                      np.array([1, 2, 3], np.int32))
+        for bad in ([], [[1, 2]], [5, 99], [-1, 2], list(range(17))):
+            with pytest.raises(ValueError):
+                a.validate_prompt(bad)
+
+    def test_pack_groups_first_fit(self):
+        a = self._adapter(pack_bucket=16)
+        items = [(i, np.zeros(n, np.int32))
+                 for i, n in enumerate([10, 7, 5, 16, 1])]
+        groups = a.pack_groups(items)
+        packed = sorted(r for g in groups for r, _ in g)
+        assert packed == [0, 1, 2, 3, 4]  # nobody dropped
+        for g in groups:
+            assert sum(p.size for _, p in g) <= 16
+        # 10+5+1 share a row, 7 and 16 ride alone -> 3 rows, not 5
+        assert len(groups) == 3
+
+
+class TestScoreboardDecodeRow:
+    _EXTRAS = {"tokens_per_sec": 100.0, "naive_tokens_per_sec": 40.0,
+               "kv_cache_speedup": 2.5, "inter_token_p99_ms": 3.0,
+               "kv_utilization": 0.8}
+
+    def _row(self, **kw):
+        row = {"workload": "serving_decode", "status": "ok",
+               "extras": dict(self._EXTRAS)}
+        row.update(kw)
+        return row
+
+    def test_complete_extras_pass(self):
+        assert _validate_row_kind(self._row()) == []
+
+    def test_missing_extra_is_schema_violation(self):
+        extras = dict(self._EXTRAS)
+        del extras["kv_cache_speedup"]
+        probs = _validate_row_kind(self._row(extras=extras))
+        assert probs and "kv_cache_speedup" in probs[0]
+        assert _validate_row_kind(self._row(extras=None))
+
+    def test_salvage_rows_exempt(self):
+        assert _validate_row_kind(self._row(status="error")) == []
+        assert _validate_row_kind(self._row(degraded=True)) == []
+
+
+# ---------------------------------------------------------------------------
+# Heavy end-to-end: engine parity, zero-compile steady state, chaos
+# ---------------------------------------------------------------------------
+def _engine(max_decode_batch=4, kv_max_blocks=64):
+    model = TransformerDecoder(vocab=64, layers=2, heads=2, head_dim=8,
+                               ff=32, max_context=64, seed=0)
+    cache = PagedKVCache(layers=2, heads=2, head_dim=8, block_tokens=8,
+                         max_blocks=kv_max_blocks)
+    adapter = TransformerAdapter(model, cache, pack_bucket=32)
+    eng = DecodeEngine(adapter, max_decode_batch=max_decode_batch)
+    eng.warmup()
+    return eng, model, cache
+
+
+@pytest.mark.slow
+class TestDecodeEngineE2E:
+    def test_concurrent_parity_zero_compile_kv_drains(self):
+        eng, model, cache = _engine()
+        rng = np.random.default_rng(2)
+        prompts = [rng.integers(0, 64, n).tolist() for n in (3, 9, 17, 5)]
+        try:
+            with CompilationTracker() as trk:
+                results = [None] * len(prompts)
+
+                def run(i):
+                    results[i] = eng.generate(prompts[i], max_new_tokens=12)
+
+                ts = [threading.Thread(target=run, args=(i,))
+                      for i in range(len(prompts))]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+            assert trk.count == 0, "steady-state decode recompiled"
+            for p, got in zip(prompts, results):
+                assert got == naive_generate(model, p, 12, pad_to=32)
+            assert cache.blocks_in_use() == 0  # every retire freed
+        finally:
+            eng.shutdown()
+
+    def test_chaos_step_isolation(self):
+        # fail:3,4 = the batch attempt + the FIRST solo retry: exactly
+        # one rider dies typed, its batchmate keeps generating, blocks
+        # drain, and the engine still serves afterwards.
+        eng, model, cache = _engine()
+        rng = np.random.default_rng(3)
+        prompts = [rng.integers(0, 64, 5).tolist(),
+                   rng.integers(0, 64, 7).tolist()]
+        outcomes = [None] * 2
+        try:
+            with faults.injected("serve.decode_step", "fail:3,4"):
+
+                def run(i):
+                    try:
+                        outcomes[i] = eng.generate(prompts[i],
+                                                   max_new_tokens=12)
+                    except DecodeStepError as e:
+                        outcomes[i] = e
+
+                ts = [threading.Thread(target=run, args=(i,))
+                      for i in range(2)]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+            died = [o for o in outcomes if isinstance(o, DecodeStepError)]
+            lived = [o for o in outcomes if isinstance(o, list)]
+            assert len(died) == 1 and len(lived) == 1
+            assert len(lived[0]) == 12  # survivor got every token
+            assert cache.blocks_in_use() == 0  # victim's KV freed too
+            # engine survives the chaos window
+            assert eng.generate(prompts[0], max_new_tokens=4) == \
+                naive_generate(model, prompts[0], 4, pad_to=32)
+        finally:
+            eng.shutdown()
+
+    def test_recurrent_engine_matches_direct_stream(self):
+        net = _stream_net()
+        adapter = RecurrentAdapter(net, feature_dim=4)
+        eng = DecodeEngine(adapter, max_decode_batch=4)
+        eng.warmup()
+        rng = np.random.default_rng(4)
+        prompt = rng.standard_normal((3, 4)).astype(np.float32)
+        try:
+            got = np.asarray(eng.generate(prompt, max_new_tokens=5))
+            ref_net = _stream_net()
+            x = prompt
+            ref = []
+            for t in range(prompt.shape[0]):
+                last = ref_net.rnn_time_step(x[t][None, :])[0]
+            for _ in range(5):
+                ref.append(last)
+                last = ref_net.rnn_time_step(last[None, :])[0]
+            np.testing.assert_allclose(got, np.asarray(ref), rtol=1e-5,
+                                       atol=1e-6)
+        finally:
+            eng.shutdown()

@@ -197,7 +197,7 @@ class TestPhaseAttribution:
             tr = fr.new_trace("m", "standard")
             tr.mark("admission")
             pi.output(np.ones((2, 3), np.float32), trace=tr)
-            assert [p for p, _ in tr.marks] == list(fr.PHASES)
+            assert [p for p, _ in tr.marks] == list(fr.ONESHOT_PHASES)
             assert tr.ctx["batch_rows"] == 2 and tr.ctx["bucket"] == 2
         finally:
             pi.shutdown()
@@ -270,7 +270,7 @@ class TestGatewaySurfaces:
                 {"model": "m", "features": [[1.0, 2.0, 3.0]]})
             assert code == 200
             phases = [p["phase"] for p in resp["trace"]["phases"]]
-            assert phases == list(fr.PHASES)
+            assert phases == list(fr.ONESHOT_PHASES)
             # wall_ms covers the phase sum (phases end at unpack; wall
             # adds only the caller wake-up)
             s = sum(p["ms"] for p in resp["trace"]["phases"])
