@@ -581,6 +581,14 @@ _ROW_KIND_EXTRAS: Dict[str, Tuple[str, ...]] = {
     "serving_decode": ("tokens_per_sec", "naive_tokens_per_sec",
                        "kv_cache_speedup", "inter_token_p99_ms",
                        "kv_utilization"),
+    # The federation chaos row (docs/serving.md §"Replica federation"):
+    # an aggregate-rps headline without the single-replica baseline,
+    # the eviction/failover counter receipts, and an explicit zero
+    # non-typed-failure count doesn't prove the fleet scaled OR that
+    # the SIGKILL arm degraded in a typed, retryable way.
+    "serving_federation": ("aggregate_rps", "single_replica_rps",
+                           "evictions", "failover_retries",
+                           "non_typed_failures"),
 }
 
 

@@ -143,6 +143,29 @@ def health_enabled_from_env() -> bool:
         "", "0", "false", "no")
 
 
+# Beat kinds: the same beat table (and the same staleness rule) now
+# carries two populations — training peers watched by
+# ClusterHealthMonitor, and serving replicas watched by the federation
+# front-end (serving/federation.py). The ``kind`` field keeps them
+# distinguishable when both ride one table.
+KIND_TRAINER = "trainer"
+KIND_REPLICA = "replica"
+
+
+def beat_ages(table: dict) -> Dict[str, float]:
+    """Age of every beat in a chief-stamped table, in seconds on the
+    CHIEF's monotonic clock (``recv_ts`` stamped at receipt vs the
+    table's ``now``) — the one staleness rule shared by the training
+    watchdog (:meth:`ClusterHealthMonitor._evaluate`) and the serving
+    federation's eviction sweep, so "dark past timeout_s" means the
+    same thing on both planes. Beats missing ``recv_ts`` read as age
+    0 (just arrived)."""
+    beats = table.get("beats", {})
+    chief_now = float(table.get("now", 0.0))
+    return {str(k): max(0.0, chief_now - float(b.get("recv_ts", chief_now)))
+            for k, b in beats.items()}
+
+
 # ---------------------------------------------------------------------------
 # Metrics
 # ---------------------------------------------------------------------------
@@ -399,7 +422,7 @@ class ClusterHealthMonitor:
             if self._failure is not None:
                 return self._failure
             beat = {"process_id": self.process_id, "step": self._step,
-                    "grace": bool(self._grace),
+                    "grace": bool(self._grace), "kind": KIND_TRAINER,
                     "send_ts": self._clock()}
         ok = True
         try:
@@ -454,7 +477,7 @@ class ClusterHealthMonitor:
         holds self._lock."""
         cfg = self.config
         beats = table.get("beats", {})
-        chief_now = float(table.get("now", now_local))
+        ages = beat_ages(table)
         self._peer_grace = any(
             b.get("grace") for k, b in beats.items()
             if int(k) != self.process_id)
@@ -474,7 +497,7 @@ class ClusterHealthMonitor:
                     lost.append(pid)
                     lost_ages.append(float("inf"))
                 continue
-            age = max(0.0, chief_now - float(b.get("recv_ts", chief_now)))
+            age = ages.get(str(pid), 0.0)
             _gauge("cluster_peer_beat_age_seconds").labels(
                 peer=str(pid)).set(age)
             pstep = int(b.get("step", 0))

@@ -12,10 +12,16 @@ the `flight_recorder` submodule; the serving control loop
 hill-climbing AutoTuner behind GET /debug/tuner) as `autotuner`. The
 autoregressive decode plane (serving/decode.py — token-granularity
 continuous batching over a paged KV cache, POST /generate,
-docs/serving.md §decode) is exported as `decode`."""
-from . import autotuner, decode, flight_recorder
+docs/serving.md §decode) is exported as `decode`. The replica
+federation plane (serving/federation.py — multi-replica serving behind
+a routing front-end with heartbeat-driven membership, typed
+exactly-once failover and rolling zero-traffic deploys, docs/serving.md
+§"Replica federation") is exported as `federation`."""
+from . import autotuner, decode, federation, flight_recorder
 from .autotuner import AutoTuner, Knob, SLOMonitor
 from .breaker import BreakerOpenError, CircuitBreaker
+from .federation import (FederationFrontEnd, ReplicaLostError,
+                         ReplicaServer, serve_replica, spawn_replica)
 from .decode import (DecodeEngine, PagedKVCache, RecurrentAdapter,
                      TransformerAdapter, TransformerDecoder,
                      naive_generate)

@@ -460,11 +460,16 @@ class Knob:
 
 def default_knobs(pool) -> List[Knob]:
     """The standing knob table (docs/observability.md §"The serving
-    control loop"): per-entry collector linger + WFQ weight, scheduler
-    quantum + shed depth — each actuated through the same reconfigure
-    seam POST /config drives, inside hard guardrails. Fused-group
-    members are skipped (reconfigure refuses them); weight/scheduler
-    knobs exist only when the pool runs a DeviceScheduler."""
+    control loop"): per-entry collector linger + WFQ weight + circuit
+    breaker threshold/cooldown, scheduler quantum + shed depth — each
+    actuated through the same reconfigure seam POST /config drives,
+    inside hard guardrails. The breaker rails are deliberately tight:
+    a threshold below 2 turns any single transient blip into an
+    outage, above 32 the breaker stops protecting anything; a cooldown
+    under 1 s thrashes probes, over 120 s parks a recovered model in
+    fast-fail. Fused-group members are skipped (reconfigure refuses
+    them); weight/scheduler knobs exist only when the pool runs a
+    DeviceScheduler; breaker knobs only for entries that carry one."""
     knobs: List[Knob] = []
     sch = pool.scheduler
     for e in pool.entries():
@@ -478,6 +483,21 @@ def default_knobs(pool) -> List[Knob]:
                 _n, batch_timeout_ms=v),
             lo=0.0, hi=20.0, step=2.0, mode="add", direction=-1,
             tier=e.tier))
+        if getattr(e, "breaker", None) is not None:
+            knobs.append(Knob(
+                f"breaker_threshold:{nm}",
+                get=lambda _e=e: _e.breaker.failure_threshold,
+                set=lambda v, _p=pool, _n=nm: _p.reconfigure(
+                    _n, breaker_threshold=v),
+                lo=2, hi=32, step=2.0, mode="mul", integer=True,
+                direction=1, tier=e.tier))
+            knobs.append(Knob(
+                f"breaker_reset_s:{nm}",
+                get=lambda _e=e: _e.breaker.reset_timeout_s,
+                set=lambda v, _p=pool, _n=nm: _p.reconfigure(
+                    _n, breaker_reset_s=v),
+                lo=1.0, hi=120.0, step=2.0, mode="mul",
+                direction=-1, tier=e.tier))
         if sch is not None:
             knobs.append(Knob(
                 f"weight:{nm}",

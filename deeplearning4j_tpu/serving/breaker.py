@@ -171,6 +171,30 @@ class CircuitBreaker:
             # already OPEN: a straggler failure from a forward that was
             # in flight when the breaker opened changes nothing.
 
+    def reconfigure(self, *, failure_threshold: Optional[int] = None,
+                    reset_timeout_s: Optional[float] = None) -> dict:
+        """Live knob set — the ``POST /config`` / ``pool.reconfigure`` /
+        AutoTuner actuator seam (docs/serving.md). Validates BOTH values
+        before mutating either, so an invalid request changes nothing.
+        Takes effect on the next decision: a raised threshold does not
+        retroactively reclose an open breaker, a shortened cooldown is
+        honored by the next ``allow()``."""
+        ft = rt = None
+        if failure_threshold is not None:
+            ft = int(failure_threshold)
+            if ft < 1:
+                raise ValueError("failure_threshold must be >= 1")
+        if reset_timeout_s is not None:
+            rt = float(reset_timeout_s)
+            if rt <= 0:
+                raise ValueError("reset_timeout_s must be > 0")
+        with self._lock:
+            if ft is not None:
+                self.failure_threshold = ft
+            if rt is not None:
+                self.reset_timeout_s = rt
+        return self.describe()
+
     def describe(self) -> dict:
         with self._lock:
             return {"state": self._state,

@@ -201,6 +201,29 @@ class ServingGateway(JsonHttpServer):
         """Checkpoint-gated hot-swap (ModelPool.swap protocol)."""
         return self.pool.swap(name, **kw)
 
+    def load(self) -> Dict[str, float]:
+        """Aggregate admission load across every entry: total queued
+        requests (the serving_queue_depth gauge's sum) and the worst
+        per-entry EWMA wait estimate. This is the signal a federation
+        replica rides on its beats so the front-end's weighted
+        least-loaded dispatch sees each replica's pressure
+        (serving/federation.py) — engines that expose no estimator
+        (decode) contribute depth only."""
+        depth = 0
+        wait = 0.0
+        for e in self.pool.entries():
+            try:
+                depth += int(e.engine.queue_depth())
+            except Exception:
+                continue
+            est = getattr(e.engine, "estimate_wait_s", None)
+            if est is not None:
+                try:
+                    wait = max(wait, float(est()))
+                except Exception:
+                    pass
+        return {"queue_depth": depth, "est_wait_s": wait}
+
     # -------------------------------------------------------------- predict
     def predict(self, name: str, x, *,
                 deadline_ms: Optional[float] = None,
@@ -678,13 +701,15 @@ class ServingGateway(JsonHttpServer):
     # Live-reconfigurable knobs POST /config accepts: per-entry
     # (routed at req["model"]) and scheduler-level (no model needed).
     _ENTRY_KNOBS = ("packed_admission", "pack_bucket", "tier", "weight",
-                    "batch_timeout_ms")
+                    "batch_timeout_ms", "breaker_threshold",
+                    "breaker_reset_s")
     _SCHED_KNOBS = ("quantum", "shed_depth", "starvation_budget",
                     "tier_slo_ms")
 
     def _config_route(self, req: dict):
         """Live reconfiguration. Per-entry knobs (packed_admission /
-        pack_bucket / tier / weight / batch_timeout_ms) route at
+        pack_bucket / tier / weight / batch_timeout_ms /
+        breaker_threshold / breaker_reset_s) route at
         req["model"]; scheduler-level knobs (quantum / shed_depth /
         starvation_budget / tier_slo_ms) need no model and create the
         shared scheduler on first use. Typed 400 on unknown knobs or
@@ -709,6 +734,10 @@ class ServingGateway(JsonHttpServer):
                 entry_kw["weight"] = float(req["weight"])
             if "batch_timeout_ms" in req:
                 entry_kw["batch_timeout_ms"] = float(req["batch_timeout_ms"])
+            if "breaker_threshold" in req:
+                entry_kw["breaker_threshold"] = int(req["breaker_threshold"])
+            if "breaker_reset_s" in req:
+                entry_kw["breaker_reset_s"] = float(req["breaker_reset_s"])
             sched_kw: Dict[str, Any] = {}
             if "quantum" in req:
                 sched_kw["quantum"] = float(req["quantum"])
@@ -731,8 +760,9 @@ class ServingGateway(JsonHttpServer):
             return 400, {"status": "error",
                          "error": "no reconfigurable knob in request "
                                   "(packed_admission/pack_bucket/tier/"
-                                  "weight/batch_timeout_ms/quantum/"
-                                  "shed_depth/starvation_budget/"
+                                  "weight/batch_timeout_ms/"
+                                  "breaker_threshold/breaker_reset_s/"
+                                  "quantum/shed_depth/starvation_budget/"
                                   "tier_slo_ms)"}
         out: Dict[str, Any] = {"status": "ok"}
         if sched_kw:

@@ -543,7 +543,9 @@ class ModelPool:
                     pack_bucket: Optional[int] = None,
                     tier: Optional[str] = None,
                     weight: Optional[float] = None,
-                    batch_timeout_ms: Optional[float] = None
+                    batch_timeout_ms: Optional[float] = None,
+                    breaker_threshold: Optional[int] = None,
+                    breaker_reset_s: Optional[float] = None
                     ) -> Dict[str, Any]:
         """Live per-entry reconfiguration (the gateway's POST /config
         surface and the AutoTuner's per-entry actuator). Tier/weight
@@ -551,7 +553,10 @@ class ModelPool:
         on first use); `batch_timeout_ms` (the collector linger) is a
         plain live set — the collector thread reads it every iteration,
         so the next coalescing window already honors it, no engine
-        rebuild, no recompile; packed-admission changes rebuild the
+        rebuild, no recompile; `breaker_threshold`/`breaker_reset_s`
+        retune the entry's circuit breaker in place
+        (CircuitBreaker.reconfigure — validated, effective on the next
+        admission decision); packed-admission changes rebuild the
         entry's engine with the new admission mode — the old engine
         drains its queue, the new one is warmed to the old bucket set
         first, and no queued request is dropped. Fused-group members
@@ -563,6 +568,17 @@ class ModelPool:
                 f"{entry.group.name!r}; eject_member() it before "
                 "reconfiguring")
         changed: List[str] = []
+        if breaker_threshold is not None or breaker_reset_s is not None:
+            if entry.breaker is None:
+                raise ValueError(
+                    f"model {name!r} has no circuit breaker to "
+                    "reconfigure")
+            entry.breaker.reconfigure(failure_threshold=breaker_threshold,
+                                      reset_timeout_s=breaker_reset_s)
+            if breaker_threshold is not None:
+                changed.append("breaker_threshold")
+            if breaker_reset_s is not None:
+                changed.append("breaker_reset_s")
         if batch_timeout_ms is not None:
             bt = float(batch_timeout_ms)
             if bt < 0:

@@ -70,6 +70,22 @@ Points used by the serving stack (docs/serving.md):
                        (DecodeStepError), frees their KV blocks, and
                        leaves decode batchmates generating
 
+Points used by the replica federation plane (docs/serving.md
+§"Replica federation"):
+
+    route.dispatch     each front-end dispatch leg (the first attempt
+                       AND the failover retry each count one call) —
+                       ``fail:`` drops the leg before the HTTP post,
+                       exercising the typed failover path without
+                       killing a replica; ``delay:SEL@MS`` injects
+                       route latency
+    replica.beat       each replica-side beat publish — ``fail:``
+                       suppresses the beat, so the replica goes dark
+                       and is evicted past timeout_s while its gateway
+                       keeps serving (the deterministic stand-in for a
+                       beat-channel partition); env-armable in replica
+                       subprocesses via DL4JTPU_FAULT_REPLICA_BEAT
+
 Environment arming: ``DL4JTPU_FAULT_<POINT>`` with dots mapped to
 underscores, e.g. ``DL4JTPU_FAULT_CHECKPOINT_WRITE="kill:3"`` — this is
 how subprocess crash tests arm the child without touching its code.

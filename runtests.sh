@@ -126,3 +126,11 @@ JAX_PLATFORMS=cpu python tests/smoke_decode.py
 # degraded: true rows and the registry snapshot embedded, and the ledger
 # row is schema-valid. Under a hard signal.alarm like the chaos smokes.
 JAX_PLATFORMS=cpu python tests/smoke_scoreboard.py
+
+# Replica federation smoke (docs/serving.md §"Replica federation"): a
+# front-end with two spawned replica subprocesses over real HTTP, a
+# predict storm, a SIGKILL of one replica mid-traffic — every response
+# 200 or typed, the dead replica evicted with the failover counters
+# fired, the survivor still answering, every federation metric family
+# in the /metrics scrape. Hard signal.alarm guard.
+JAX_PLATFORMS=cpu python tests/smoke_federation.py

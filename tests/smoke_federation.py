@@ -1,0 +1,167 @@
+"""Replica-federation smoke for runtests.sh (docs/serving.md §"Replica
+federation") — the chaos-smoke pattern: a hard signal.alarm bounds the
+whole script so a federation regression can never wedge the CI gate.
+
+One end-to-end drill over real HTTP: a front-end with TWO spawned
+replica subprocesses, a concurrent predict storm, a SIGKILL of one
+replica mid-traffic. The gate demands:
+
+  * every storm response is 200 or a TYPED error body (a connection
+    error or an untyped body to the FRONT-END is a failure)
+  * the killed replica is evicted from the routable set and the
+    eviction + failover counters fired
+  * the survivor keeps answering (200s continue after the kill)
+  * every federation metric family is present in the /metrics scrape
+
+Replica startup costs a jax import + warmup compile each on the 1-core
+rig, so the alarm is generous; the deterministic state-machine coverage
+lives in tests/test_federation.py's fast (fake-transport) tests.
+"""
+import json
+import os
+import signal
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+signal.alarm(420)  # the gate must never wedge, whatever breaks below
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from deeplearning4j_tpu.optimize.metrics import registry  # noqa: E402
+from deeplearning4j_tpu.parallel.cluster_health import HealthConfig  # noqa: E402
+from deeplearning4j_tpu.serving.federation import (DEAD,  # noqa: E402
+                                                   FederationFrontEnd,
+                                                   spawn_replica)
+
+REQUIRED_FAMILIES = (
+    "serving_replicas",
+    "serving_replica_evictions_total",
+    "serving_failover_retries_total",
+    "serving_replica_dispatch_total",
+)
+
+REPLICA_ENV = {"JAX_PLATFORMS": "cpu",
+               "DL4JTPU_REPLICA_N_IN": "4",
+               "DL4JTPU_REPLICA_HIDDEN": "8",
+               "DL4JTPU_REPLICA_N_OUT": "3",
+               "DL4JTPU_REPLICA_BATCH_LIMIT": "8",
+               "DL4JTPU_REPLICA_BATCH_TIMEOUT_MS": "2.0"}
+
+
+def post(url, payload, timeout=30.0):
+    body = json.dumps(payload).encode()
+    req = urllib.request.Request(url, body,
+                                 {"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def main() -> int:
+    failures = []
+    x = np.random.default_rng(0).standard_normal((2, 4)).astype(
+        np.float32).tolist()
+    fe = FederationFrontEnd(
+        health=HealthConfig(interval_s=0.25, timeout_s=2.0))
+    fe.start()
+    procs = []
+    try:
+        procs = [spawn_replica(i, fe.url, env=REPLICA_ENV)
+                 for i in range(2)]
+        if not fe.wait_for_replicas(2, timeout=240):
+            failures.append("fleet never became healthy")
+            return _report(failures)
+
+        results, errors = [], []
+        stop = threading.Event()
+        killed_at = [None]
+
+        def client():
+            while not stop.is_set():
+                t = time.monotonic()
+                try:
+                    results.append(
+                        (t, post(fe.url + "/predict",
+                                 {"model": "default", "features": x})))
+                except Exception as e:  # non-typed front-end failure
+                    errors.append(e)
+
+        ts = [threading.Thread(target=client) for _ in range(4)]
+        for t in ts:
+            t.start()
+        time.sleep(1.0)                          # storm established
+        killed_at[0] = time.monotonic()
+        procs[1].kill()                          # SIGKILL mid-traffic
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            with fe._lock:
+                if fe._replicas[1].state == DEAD:
+                    break
+            time.sleep(0.05)
+        time.sleep(1.0)                          # survivor keeps serving
+        stop.set()
+        for t in ts:
+            t.join(timeout=30)
+
+        if errors:
+            failures.append(f"{len(errors)} non-typed failure(s) at the "
+                            f"front-end: {errors[:3]}")
+        if not results:
+            failures.append("storm produced no responses")
+        untyped = [(c, b) for _, (c, b) in results
+                   if c != 200 and "reason" not in b and "error" not in b]
+        if untyped:
+            failures.append(f"untyped error bodies: {untyped[:3]}")
+        post_kill_ok = [1 for t, (c, _) in results
+                        if c == 200 and t > killed_at[0] + 0.5]
+        if not post_kill_ok:
+            failures.append("no 200s after the SIGKILL — the survivor "
+                            "did not keep serving")
+        with fe._lock:
+            state = fe._replicas[1].state
+        if state != DEAD:
+            failures.append(f"killed replica never evicted "
+                            f"(state={state!r})")
+        if registry().counter(
+                "serving_replica_evictions_total", "").total() < 1:
+            failures.append("eviction counter never fired")
+        n200 = sum(1 for _, (c, _) in results if c == 200)
+        print(f"[smoke_federation] storm: {len(results)} responses "
+              f"({n200} ok), {len(errors)} non-typed, replica 1 {state}")
+
+        with urllib.request.urlopen(fe.url + "/metrics",
+                                    timeout=10) as r:
+            scrape = r.read().decode()
+        missing = [f for f in REQUIRED_FAMILIES if f not in scrape]
+        if missing:
+            failures.append(f"metric families missing from the scrape: "
+                            f"{missing}")
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+            p.wait(timeout=10)
+        fe.stop()
+    return _report(failures)
+
+
+def _report(failures) -> int:
+    if failures:
+        print("[smoke_federation] FAILED:")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("[smoke_federation] OK: SIGKILL mid-storm -> typed failover, "
+          "eviction, survivor serving, families scraped")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

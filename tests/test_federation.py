@@ -1,0 +1,908 @@
+"""Replica federation (ISSUE 19): multi-replica serving behind one
+routing front-end (docs/serving.md §"Replica federation").
+
+Covers the tentpole legs deterministically — membership state machine
+on the PR-9 beat table (join, warm, fake-clock eviction, rejoin),
+weighted least-loaded dispatch, typed passthrough of replica-chosen
+statuses, the exactly-once failover gate (connection-error path,
+eviction-sweep path, the two racing), the never-retry-decode rule with
+``tokens_so_far`` attached, the ``route.dispatch`` chaos seam, rolling
+zero-traffic swap (canary order, drain windows, typed aborts), config
+fan-out — and the satellite surfaces: live breaker knobs through
+pool.reconfigure / POST /config / the AutoTuner knob table, and the
+replica-side beat publisher with its ``replica.beat`` chaos point.
+
+Fast tests inject a fake transport + fake clock (no subprocesses, no
+sockets to replicas). The subprocess fleet — SIGKILL chaos mid-storm,
+rolling swap under live traffic with bitwise canary rollback, env-armed
+beat suppression — is ``slow`` (each replica costs a jax import plus a
+warmup compile on the 1-core rig); tier-1 keeps the logic via the fakes
+and tests/smoke_federation.py keeps one end-to-end drill in the gate.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.optimize.metrics import registry
+from deeplearning4j_tpu.parallel.cluster_health import (KIND_REPLICA,
+                                                        HealthConfig,
+                                                        beat_ages)
+from deeplearning4j_tpu.parallel.inference import ServerClosedError
+from deeplearning4j_tpu.serving import (FederationFrontEnd,
+                                        ReplicaLostError, ReplicaServer,
+                                        ServingGateway)
+from deeplearning4j_tpu.serving.autotuner import default_knobs
+from deeplearning4j_tpu.serving.federation import (DEAD, DRAINING,
+                                                   HEALTHY, JOINING)
+from deeplearning4j_tpu.utils import faults
+
+from test_serving_gateway import _StubModel, make_net, post_json, rand_x
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+class FakeFleet:
+    """A front-end wired to an in-memory replica fleet: the transport
+    records every leg, per-replica behaviour is scripted (alive, typed
+    status, blocking), and the clock is a hand-cranked float — so
+    membership, failover and swap sequencing are deterministic."""
+
+    def __init__(self, *, timeout_s=5.0, **fe_kw):
+        self.now = [0.0]
+        self.calls = []          # (replica_id, route, payload)
+        self.dead = set()        # connection-refused replicas
+        self.responses = {}      # (rid, route) -> (status, body) script
+        self.blocks = {}         # (rid, route) -> threading.Event
+        self.lock = threading.Lock()
+        self.fe = FederationFrontEnd(
+            health=HealthConfig(interval_s=0.5, timeout_s=timeout_s),
+            transport=self._transport, clock=lambda: self.now[0],
+            **fe_kw)
+
+    def _transport(self, url, payload, timeout):
+        rid = int(url.split("//r")[1].split("/")[0])
+        route = url.rsplit("/", 1)[1]
+        with self.lock:
+            self.calls.append((rid, route, payload))
+        gate = self.blocks.get((rid, route))
+        if gate is not None:
+            assert gate.wait(timeout=10), "blocked transport never freed"
+        if rid in self.dead:
+            raise urllib.error.URLError("connection refused")
+        scripted = self.responses.get((rid, route))
+        if scripted is not None:
+            return scripted
+        return 200, {"status": "ok", "replica": rid,
+                     "request_id": (payload or {}).get("request_id")}
+
+    def beat(self, rid, *, warmed=True, queue_depth=0, est_wait_s=0.0,
+             weight=1.0):
+        return self.fe._beat_route({
+            "process_id": rid, "kind": KIND_REPLICA,
+            "url": f"http://r{rid}", "warmed": warmed,
+            "queue_depth": queue_depth, "est_wait_s": est_wait_s,
+            "weight": weight, "send_ts": self.now[0]})
+
+    def join(self, *rids, **kw):
+        for rid in rids:
+            code, body = self.beat(rid, **kw)
+            assert code == 200 and body["state"] == HEALTHY, body
+
+    def state(self, rid):
+        with self.fe._lock:
+            return self.fe._replicas[rid].state
+
+    def legs(self, route=None):
+        with self.lock:
+            return [c for c in self.calls
+                    if route is None or c[1] == route]
+
+
+# ---------------------------------------------------------------------------
+# Typed chain
+# ---------------------------------------------------------------------------
+class TestTypedChain:
+    def test_replica_lost_is_server_closed(self):
+        e = ReplicaLostError("gone", replica=3, tokens_so_far=[1, 2])
+        assert isinstance(e, ServerClosedError)
+        assert e.transient  # retryable family, like the rest of the chain
+        assert e.replica == 3 and e.tokens_so_far == [1, 2]
+        assert ReplicaLostError("x").tokens_so_far == []
+
+
+# ---------------------------------------------------------------------------
+# Tentpole: membership state machine on the beat table
+# ---------------------------------------------------------------------------
+class TestMembership:
+    def test_joining_until_warmed_then_routable(self):
+        fl = FakeFleet()
+        code, body = fl.beat(0, warmed=False)
+        assert code == 200 and body["state"] == JOINING
+        # not routable while joining
+        code, body = fl.fe._predict_route({"inputs": [1]})
+        assert code == 503 and body["reason"] == "replica_lost"
+        code, body = fl.beat(0, warmed=True)
+        assert body["state"] == HEALTHY
+        code, body = fl.fe._predict_route({"inputs": [1]})
+        assert code == 200 and body["replica"] == 0
+
+    def test_beat_requires_identity(self):
+        fl = FakeFleet()
+        code, _ = fl.fe._beat_route({"url": "http://r0"})
+        assert code == 400
+
+    def test_fake_clock_eviction_and_rejoin(self):
+        fl = FakeFleet(timeout_s=5.0)
+        fl.join(0, 1)
+        fl.now[0] = 3.0
+        fl.beat(1)                       # 1 stays fresh
+        fl.now[0] = 6.0                  # 0's beat is now 6s old
+        assert fl.fe.poll_once() == [0]
+        assert fl.state(0) == DEAD and fl.state(1) == HEALTHY
+        assert fl.fe.poll_once() == []   # eviction is idempotent
+        # recovered replica rejoins through JOINING, warms, routes again
+        code, body = fl.beat(0, warmed=False)
+        assert body["state"] == JOINING
+        code, body = fl.beat(0, warmed=True)
+        assert body["state"] == HEALTHY
+
+    def test_beats_refresh_load_and_population_gauge(self):
+        fl = FakeFleet()
+        fl.join(0)
+        fl.beat(0, queue_depth=7, est_wait_s=0.25)
+        with fl.fe._lock:
+            rep = fl.fe._replicas[0]
+            assert rep.queue_depth == 7 and rep.est_wait_s == 0.25
+        g = registry().gauge("serving_replicas", "")
+        assert g.value(state=HEALTHY) >= 1.0
+
+    def test_health_route_tracks_population(self):
+        fl = FakeFleet()
+        assert fl.fe._health_route(None)[1]["status"] == "down"
+        fl.join(0, 1)
+        assert fl.fe._health_route(None)[1]["status"] == "ok"
+        fl.dead.add(1)
+        fl.beat(0, queue_depth=10)                  # steer the pick to 1
+        fl.fe.dispatch("predict", {"inputs": [1]})  # evicts 1 via dispatch
+        code, body = fl.fe._health_route(None)
+        assert body["status"] == "degraded"
+        assert body["replicas"][DEAD] == 1
+
+
+# ---------------------------------------------------------------------------
+# Tentpole: weighted least-loaded dispatch
+# ---------------------------------------------------------------------------
+class TestDispatchRouting:
+    def test_least_loaded_by_queue_depth(self):
+        fl = FakeFleet()
+        fl.join(0, 1)
+        fl.beat(0, queue_depth=10)
+        code, body = fl.fe.dispatch("predict", {"inputs": [1]})
+        assert body["replica"] == 1
+
+    def test_est_wait_breaks_depth_ties(self):
+        fl = FakeFleet()
+        fl.join(0, 1)
+        fl.beat(0, est_wait_s=2.0)
+        assert fl.fe.dispatch("predict", {})[1]["replica"] == 1
+
+    def test_weight_scales_capacity(self):
+        fl = FakeFleet()
+        fl.join(0, 1)
+        # same reported load, but 1 advertises 4x the capacity
+        fl.beat(0, queue_depth=2, weight=1.0)
+        fl.beat(1, queue_depth=2, weight=4.0)
+        assert fl.fe.dispatch("predict", {})[1]["replica"] == 1
+
+    def test_typed_replica_status_passes_through(self):
+        fl = FakeFleet()
+        fl.join(0)
+        fl.responses[(0, "predict")] = (429, {"status": "shed",
+                                              "reason": "queue_full"})
+        code, body = fl.fe.dispatch("predict", {"inputs": [1]})
+        assert (code, body["reason"]) == (429, "queue_full")
+        assert fl.state(0) == HEALTHY          # alive replica: no evict
+        assert len(fl.legs("predict")) == 1    # typed reply: no retry
+
+    def test_request_id_assigned_and_forwarded(self):
+        fl = FakeFleet()
+        fl.join(0)
+        code, body = fl.fe.dispatch("predict", {"inputs": [1]})
+        sent = fl.legs("predict")[0][2]
+        assert sent["request_id"] == body["request_id"]
+        code, body = fl.fe.dispatch("predict", {"request_id": "mine"})
+        assert body["request_id"] == "mine"
+
+
+# ---------------------------------------------------------------------------
+# Tentpole: typed exactly-once failover
+# ---------------------------------------------------------------------------
+class TestFailover:
+    def test_dead_replica_evicted_and_retried_once_on_sibling(self):
+        fl = FakeFleet()
+        fl.join(0, 1)
+        fl.dead.add(0)
+        fl.beat(1, queue_depth=10)     # steer first pick to 0
+        before = registry().counter(
+            "serving_failover_retries_total", "").total(outcome="ok")
+        code, body = fl.fe.dispatch("predict", {"inputs": [1]})
+        assert code == 200 and body["replica"] == 1
+        assert fl.state(0) == DEAD
+        legs = fl.legs("predict")
+        assert [l[0] for l in legs] == [0, 1]  # exactly one retry leg
+        after = registry().counter(
+            "serving_failover_retries_total", "").total(outcome="ok")
+        assert after == before + 1
+
+    def test_failed_retry_is_typed_and_final(self):
+        fl = FakeFleet()
+        fl.join(0, 1)
+        fl.dead.update({0, 1})
+        code, body = fl.fe.dispatch("predict", {"inputs": [1]})
+        assert code == 503 and body["reason"] == "replica_lost"
+        assert "request_id" in body
+        assert len(fl.legs("predict")) == 2    # never a third leg
+        assert fl.state(0) == DEAD and fl.state(1) == DEAD
+
+    def test_no_sibling_is_typed(self):
+        fl = FakeFleet()
+        fl.join(0)
+        fl.dead.add(0)
+        before = registry().counter(
+            "serving_failover_retries_total", "").total(
+                outcome="no_sibling")
+        code, body = fl.fe.dispatch("predict", {"inputs": [1]})
+        assert code == 503 and body["reason"] == "replica_lost"
+        assert registry().counter(
+            "serving_failover_retries_total", "").total(
+                outcome="no_sibling") == before + 1
+
+    def test_generate_never_retried_mid_stream(self):
+        fl = FakeFleet()
+        fl.join(0, 1)
+        fl.dead.add(0)
+        fl.beat(1, queue_depth=10)
+        code, body = fl.fe.dispatch("generate", {"prompt": [1, 2]})
+        assert code == 503 and body["reason"] == "replica_lost"
+        assert body["tokens_so_far"] == []
+        # the healthy sibling never saw the decode request
+        assert [l[0] for l in fl.legs("generate")] == [0]
+        assert registry().counter(
+            "serving_failover_retries_total", "").total(
+                outcome="decode_suppressed") >= 1
+
+    def test_eviction_sweep_fails_over_inflight_request(self):
+        """A request stuck on a replica whose beats go dark is failed
+        over BY THE SWEEP — the client gets the sibling's answer, and
+        when the wedged original eventually returns its result is
+        discarded (first-settle-wins: exactly one client response)."""
+        fl = FakeFleet()
+        fl.join(0, 1)
+        fl.beat(1, queue_depth=10)           # steer to 0
+        gate = threading.Event()
+        fl.blocks[(0, "predict")] = gate
+        out = {}
+        t = threading.Thread(target=lambda: out.setdefault(
+            "r", fl.fe.dispatch("predict", {"inputs": [1]})))
+        t.start()
+        deadline = time.monotonic() + 5
+        while not fl.legs("predict") and time.monotonic() < deadline:
+            time.sleep(0.005)
+        with fl.fe._lock:
+            rep0 = fl.fe._replicas[0]
+        fl.fe._evict(rep0, reason="beat_timeout")
+        # the sweep's failover thread answers via replica 1
+        deadline = time.monotonic() + 5
+        while len(fl.legs("predict")) < 2 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        gate.set()                            # wedged original completes
+        t.join(timeout=10)
+        assert out["r"][0] == 200 and out["r"][1]["replica"] == 1
+        assert [l[0] for l in fl.legs("predict")] == [0, 1]
+
+    def test_concurrent_failover_signals_retry_exactly_once(self):
+        """The dedup claim: the dispatch thread's connection error and
+        the eviction sweep race into _fail_over for the SAME request —
+        the sibling must execute it exactly once and both paths must
+        report the same settled outcome."""
+        fl = FakeFleet()
+        fl.join(0, 1)
+        slow = threading.Event()
+        fl.blocks[(1, "predict")] = slow     # make the retry leg slow
+        req = fl.fe._requests  # noqa: F841  (touch: counters exist)
+        from deeplearning4j_tpu.serving.federation import _Request
+        r = _Request("rid-1", "predict", {"request_id": "rid-1"})
+        r.tried.add(0)
+        with fl.fe._lock:
+            rep0 = fl.fe._replicas[0]
+        results = []
+        cause = ReplicaLostError("boom", replica=0)
+        ts = [threading.Thread(
+            target=lambda: results.append(
+                fl.fe._fail_over(r, rep0, cause=cause)))
+            for _ in range(4)]
+        for t in ts:
+            t.start()
+        time.sleep(0.1)
+        slow.set()
+        for t in ts:
+            t.join(timeout=10)
+        assert len(fl.legs("predict")) == 1          # ONE retry leg
+        assert len(set((s, json.dumps(b, sort_keys=True))
+                       for s, b in results)) == 1    # ONE outcome
+
+    def test_route_dispatch_fault_fails_over_without_evicting(self):
+        fl = FakeFleet()
+        fl.join(0, 1)
+        fl.beat(1, queue_depth=10)
+        with faults.injected("route.dispatch", "fail:1"):
+            code, body = fl.fe.dispatch("predict", {"inputs": [1]})
+            assert faults.fired_count("route.dispatch") == 1
+        assert code == 200 and body["replica"] == 1
+        assert fl.state(0) == HEALTHY      # dropped LEG, live replica
+
+
+# ---------------------------------------------------------------------------
+# Tentpole: rolling zero-traffic swap
+# ---------------------------------------------------------------------------
+class TestRollingSwap:
+    def test_canary_then_promote_with_traffic_steered_away(self):
+        fl = FakeFleet()
+        fl.join(0, 1, 2)
+        states_at_swap = {}
+
+        def scripted(url, payload, timeout):
+            rid = int(url.split("//r")[1].split("/")[0])
+            route = url.rsplit("/", 1)[1]
+            fl.calls.append((rid, route, payload))
+            if route == "swap":
+                states_at_swap[rid] = fl.state(rid)
+                return 200, {"status": "ok", "version": 2}
+            return 200, {"status": "ok", "replica": rid}
+        fl.fe._transport = scripted
+        code, body = fl.fe._swap_route({"model": "default",
+                                        "checkpoint": "ckpt-2"})
+        assert code == 200, body
+        assert body["canary"] == 0 and body["swapped"] == [0, 1, 2]
+        # each replica was DRAINING (zero federation traffic) during
+        # its swap leg, and every one is routable again after
+        assert states_at_swap == {0: DRAINING, 1: DRAINING, 2: DRAINING}
+        assert all(fl.state(r) == HEALTHY for r in (0, 1, 2))
+        # checkpoint request forwarded verbatim to each replica
+        swap_legs = fl.legs("swap")
+        assert [l[0] for l in swap_legs] == [0, 1, 2]
+        assert all(l[2]["checkpoint"] == "ckpt-2" for l in swap_legs)
+
+    def test_canary_rejection_aborts_roll_untouched_fleet(self):
+        fl = FakeFleet()
+        fl.join(0, 1)
+        fl.responses[(0, "swap")] = (
+            409, {"status": "swap_failed", "error": "canary drift 0.9"})
+        code, body = fl.fe._swap_route({"checkpoint": "bad"})
+        assert code == 409
+        assert body["stage"] == "canary" and body["replica"] == 0
+        assert body["swapped"] == []           # nothing promoted
+        assert [l[0] for l in fl.legs("swap")] == [0]  # 1 never swapped
+        assert fl.state(0) == HEALTHY          # rolled back replica serves
+
+    def test_promote_failure_reports_partial_roll(self):
+        fl = FakeFleet()
+        fl.join(0, 1, 2)
+        fl.responses[(1, "swap")] = (409, {"status": "swap_failed",
+                                           "error": "drift"})
+        code, body = fl.fe._swap_route({"checkpoint": "c"})
+        assert code == 409 and body["stage"] == "promote"
+        assert body["swapped"] == [0] and body["replica"] == 1
+        assert [l[0] for l in fl.legs("swap")] == [0, 1]
+
+    def test_drain_timeout_aborts_typed(self):
+        fl = FakeFleet()
+        fl.fe.drain_timeout_s = 0.05
+        fl.join(0)
+        from deeplearning4j_tpu.serving.federation import _Request
+        stuck = _Request("stuck", "predict", {})
+        with fl.fe._lock:
+            fl.fe._replicas[0].inflight.add(stuck)
+        code, body = fl.fe._swap_route({"checkpoint": "c"})
+        assert code == 409 and body["stage"] == "canary"
+        assert "drain" in body["error"]
+        assert fl.legs("swap") == []           # never swapped mid-flight
+        assert fl.state(0) == HEALTHY
+
+    def test_replica_death_mid_swap_evicts_and_aborts(self):
+        fl = FakeFleet()
+        fl.join(0, 1)
+        real = fl._transport
+
+        def dying(url, payload, timeout):
+            if url.endswith("/swap"):
+                fl.calls.append((0, "swap", payload))
+                raise urllib.error.URLError("reset by peer")
+            return real(url, payload, timeout)
+        fl.fe._transport = dying
+        code, body = fl.fe._swap_route({"checkpoint": "c"})
+        assert code == 409 and "died mid-swap" in body["error"]
+        assert fl.state(0) == DEAD and fl.state(1) == HEALTHY
+
+    def test_concurrent_roll_rejected(self):
+        fl = FakeFleet()
+        fl.join(0)
+        fl.fe._swap_lock.acquire()
+        try:
+            code, body = fl.fe._swap_route({"checkpoint": "c"})
+            assert code == 409 and "in progress" in body["error"]
+        finally:
+            fl.fe._swap_lock.release()
+
+    def test_swap_without_healthy_fleet_is_typed(self):
+        fl = FakeFleet()
+        code, body = fl.fe._swap_route({"checkpoint": "c"})
+        assert code == 503 and body["reason"] == "replica_lost"
+
+
+# ---------------------------------------------------------------------------
+# Tentpole: config fan-out
+# ---------------------------------------------------------------------------
+class TestConfigFanOut:
+    def test_fans_out_to_all_live_replicas(self):
+        fl = FakeFleet()
+        fl.join(0, 1)
+        fl.responses[(0, "config")] = (200, {"status": "ok"})
+        fl.responses[(1, "config")] = (200, {"status": "ok"})
+        code, body = fl.fe._config_route({"model": "default",
+                                          "breaker_threshold": 8})
+        assert code == 200 and set(body["replicas"]) == {"0", "1"}
+        assert all(l[2] == {"model": "default", "breaker_threshold": 8}
+                   for l in fl.legs("config"))
+
+    def test_worst_status_wins_with_per_replica_verdicts(self):
+        fl = FakeFleet()
+        fl.join(0, 1)
+        fl.responses[(1, "config")] = (400, {"status": "error",
+                                             "error": "unknown_knob"})
+        code, body = fl.fe._config_route({"model": "m", "weight": 2.0})
+        assert code == 400 and body["status"] == "error"
+        assert body["replicas"]["0"]["code"] == 200
+        assert body["replicas"]["1"]["code"] == 400
+
+    def test_single_replica_targeting(self):
+        fl = FakeFleet()
+        fl.join(0, 1)
+        code, body = fl.fe._config_route({"model": "m", "weight": 2.0,
+                                          "replica": 1})
+        assert code == 200 and set(body["replicas"]) == {"1"}
+        sent = fl.legs("config")[0][2]
+        assert "replica" not in sent       # routing key stripped
+
+
+# ---------------------------------------------------------------------------
+# Satellite: breaker knobs live — pool.reconfigure, /config, AutoTuner
+# ---------------------------------------------------------------------------
+class TestBreakerKnobs:
+    def test_pool_reconfigure_validates_then_applies(self):
+        gw = ServingGateway()
+        gw.add_model("m", _StubModel(), check_finite=False,
+                     breaker_threshold=5, breaker_reset_s=30.0)
+        try:
+            entry = gw.pool.get("m")
+            out = gw.pool.reconfigure("m", breaker_threshold=9,
+                                      breaker_reset_s=2.5)
+            assert set(out["reconfigured"]) == {"breaker_threshold",
+                                                "breaker_reset_s"}
+            assert entry.breaker.failure_threshold == 9
+            assert entry.breaker.reset_timeout_s == 2.5
+            # invalid values reject BEFORE mutating either knob
+            with pytest.raises(ValueError):
+                gw.pool.reconfigure("m", breaker_threshold=0,
+                                    breaker_reset_s=60.0)
+            assert entry.breaker.failure_threshold == 9
+            assert entry.breaker.reset_timeout_s == 2.5
+        finally:
+            gw.pool.shutdown()
+
+    def test_breaker_knobs_over_http_config(self):
+        gw = ServingGateway()
+        gw.add_model("m", _StubModel(), check_finite=False)
+        with gw:
+            code, body = post_json(gw.url + "/config",
+                                   {"model": "m", "breaker_threshold": 3,
+                                    "breaker_reset_s": 0.5})
+            assert code == 200, (code, body)
+            assert set(body["reconfigured"]) == {"breaker_threshold",
+                                                 "breaker_reset_s"}
+            desc = gw.pool.get("m").breaker.describe()
+            assert desc["failure_threshold"] == 3
+            assert desc["reset_timeout_s"] == 0.5
+            code, body = post_json(gw.url + "/config",
+                                   {"model": "m", "breaker_threshold": 0})
+            assert code == 409                  # pool-level ValueError
+
+    def test_new_threshold_takes_effect_immediately(self):
+        boom = _StubModel()
+        boom.output = lambda x: (_ for _ in ()).throw(RuntimeError("x"))
+        gw = ServingGateway()
+        gw.add_model("m", boom, check_finite=False, breaker_threshold=50)
+        try:
+            gw.pool.reconfigure("m", breaker_threshold=2)
+            for _ in range(2):
+                with pytest.raises(Exception):
+                    gw.predict("m", rand_x(1))
+            assert gw.pool.get("m").breaker.describe()["state"] == "open"
+        finally:
+            gw.pool.shutdown()
+
+    def test_autotuner_exposes_breaker_knobs_with_rails(self):
+        gw = ServingGateway()
+        gw.add_model("m", _StubModel(), check_finite=False,
+                     breaker_threshold=5, breaker_reset_s=30.0)
+        try:
+            knobs = {k.name: k for k in default_knobs(gw.pool)}
+            kt = knobs["breaker_threshold:m"]
+            kr = knobs["breaker_reset_s:m"]
+            # hard guardrails: never below the floor, never above the cap
+            assert (kt.lo, kt.hi) == (2, 32)
+            assert (kr.lo, kr.hi) == (1.0, 120.0)
+            # actuation goes through pool.reconfigure
+            kt.apply(7)
+            assert gw.pool.get("m").breaker.failure_threshold == 7
+            # propose() refuses to step past a rail (threshold climbs,
+            # reset shrinks — each pins at its travel-direction edge)
+            gw.pool.reconfigure("m", breaker_threshold=32)
+            assert kt.propose()[0] is None      # pinned at hi
+            gw.pool.reconfigure("m", breaker_reset_s=1.0)
+            assert kr.propose()[0] is None      # pinned at lo
+        finally:
+            gw.pool.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Satellite: replica-side beat publisher + replica.beat chaos point
+# ---------------------------------------------------------------------------
+class _StubGateway:
+    url = "http://replica:1"
+
+    def load(self):
+        return {"queue_depth": 3, "est_wait_s": 0.125}
+
+
+class TestReplicaServer:
+    def test_beat_payload_carries_kind_load_and_warmth(self):
+        sent = []
+        rs = ReplicaServer(_StubGateway(), replica_id=4,
+                           frontend_url="http://fe",
+                           transport=lambda u, p, t: sent.append((u, p)))
+        rs.beat_once()
+        rs.mark_warmed()
+        rs.beat_once()
+        url, beat = sent[0]
+        assert url == "http://fe/beat"
+        assert beat["process_id"] == 4 and beat["kind"] == KIND_REPLICA
+        assert beat["url"] == "http://replica:1"
+        assert beat["queue_depth"] == 3 and beat["est_wait_s"] == 0.125
+        assert beat["warmed"] is False and sent[1][1]["warmed"] is True
+
+    def test_replica_beat_fault_suppresses_the_beat(self):
+        sent = []
+        rs = ReplicaServer(_StubGateway(), replica_id=0,
+                           frontend_url="http://fe",
+                           transport=lambda u, p, t: sent.append(p))
+        with faults.injected("replica.beat", "fail:2"):
+            rs.beat_once()
+            with pytest.raises(faults.FaultInjected):
+                rs.beat_once()
+            rs.beat_once()
+        assert len(sent) == 2   # the armed call published nothing
+
+    def test_suppressed_beats_go_dark_then_evicted(self):
+        """replica.beat chaos end-to-end against a front-end: the
+        replica's gateway is fine, but its beat channel fails — past
+        timeout_s the front-end evicts it."""
+        fl = FakeFleet(timeout_s=5.0)
+        rs = ReplicaServer(
+            _StubGateway(), replica_id=0, frontend_url="http://fe",
+            transport=lambda u, p, t: fl.fe._beat_route(p))
+        rs.mark_warmed()
+        rs.beat_once()
+        assert fl.state(0) == HEALTHY
+        with faults.injected("replica.beat", "fail:*"):
+            for _ in range(3):
+                with pytest.raises(faults.FaultInjected):
+                    rs.beat_once()
+        fl.now[0] = 6.0
+        assert fl.fe.poll_once() == [0]
+        assert fl.state(0) == DEAD
+
+    def test_beat_loop_survives_transport_failures(self):
+        def broken(u, p, t):
+            raise ConnectionError("fe down")
+        rs = ReplicaServer(_StubGateway(), replica_id=0,
+                           frontend_url="http://fe", interval_s=0.01,
+                           transport=broken)
+        rs.start()
+        deadline = time.monotonic() + 5
+        while rs.beat_failures < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        rs.stop()
+        assert rs.beat_failures >= 3
+
+
+# ---------------------------------------------------------------------------
+# Satellite: gateway.load() — the admission signal beats carry
+# ---------------------------------------------------------------------------
+class TestGatewayLoad:
+    def test_load_aggregates_entry_queues(self):
+        gate = threading.Event()
+        gw = ServingGateway()
+        gw.add_model("m", _StubModel(gate=gate), check_finite=False,
+                     batch_limit=1, queue_limit=64)
+        try:
+            out = gw.load()
+            assert out == {"queue_depth": 0, "est_wait_s": 0.0}
+            ts = [threading.Thread(
+                target=lambda: gw.predict("m", rand_x(1)))
+                for _ in range(4)]
+            for t in ts:
+                t.start()
+            deadline = time.monotonic() + 5
+            while gw.load()["queue_depth"] < 1 \
+                    and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert gw.load()["queue_depth"] >= 1
+            gate.set()
+            for t in ts:
+                t.join(timeout=10)
+        finally:
+            gate.set()
+            gw.pool.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Slow: the real fleet — subprocess replicas over HTTP
+# ---------------------------------------------------------------------------
+def _fe_post(url, payload, timeout=30.0):
+    body = json.dumps(payload).encode()
+    req = urllib.request.Request(url, body,
+                                 {"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+_FLEET_ENV = {"JAX_PLATFORMS": "cpu",
+              "DL4JTPU_REPLICA_N_IN": "4",
+              "DL4JTPU_REPLICA_HIDDEN": "8",
+              "DL4JTPU_REPLICA_N_OUT": "3",
+              "DL4JTPU_REPLICA_BATCH_LIMIT": "8",
+              "DL4JTPU_REPLICA_BATCH_TIMEOUT_MS": "2.0"}
+
+
+def _fleet_net(seed=42):
+    """The default_builder net, byte-for-byte (same geometry as
+    _FLEET_ENV, same layer types): checkpoints decode into the live
+    tree's template, so a swap candidate must match it exactly."""
+    from deeplearning4j_tpu import (Adam, DenseLayer, InputType,
+                                    MultiLayerNetwork,
+                                    NeuralNetConfiguration, OutputLayer,
+                                    WeightInit)
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(Adam(1e-3)).weight_init(WeightInit.XAVIER).list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _spawn_fleet(fe, n, ckpt_dir, extra_env=None):
+    from deeplearning4j_tpu.serving.federation import spawn_replica
+    env = dict(_FLEET_ENV)
+    env["DL4JTPU_REPLICA_CKPT_DIR"] = str(ckpt_dir)
+    env.update(extra_env or {})
+    procs = [spawn_replica(i, fe.url, env=env) for i in range(n)]
+    assert fe.wait_for_replicas(n, timeout=180), \
+        "fleet never became healthy"
+    return procs
+
+
+def _kill_fleet(procs):
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+        p.wait(timeout=10)
+
+
+@pytest.mark.slow
+class TestSubprocessFleet:
+    def test_sigkill_chaos_and_rolling_swap_under_live_traffic(
+            self, tmp_path):
+        """The full drill in one fleet (replica startup is the cost on
+        this rig): (1) 2-replica storm with a SIGKILL mid-traffic —
+        every response 200 or typed, eviction + failover counters
+        fire; (2) restart the lost replica, rejoin; (3) rolling swap
+        under live traffic — a NaN checkpoint canary-rejects with
+        bitwise restore, a good checkpoint promotes everywhere with
+        zero dropped requests."""
+        from deeplearning4j_tpu.optimize.resilience import \
+            CheckpointManager
+        ckdir = tmp_path / "ckpts"
+        ckdir.mkdir()
+        mgr = CheckpointManager(str(ckdir))
+        fe = FederationFrontEnd(
+            health=HealthConfig(interval_s=0.25, timeout_s=2.0))
+        fe.start()
+        procs = []
+        try:
+            procs = _spawn_fleet(fe, 2, ckdir)
+            x = rand_x(4).tolist()
+
+            # -- phase 1: chaos storm --------------------------------
+            results, errors = [], []
+            stop = threading.Event()
+
+            def client(sink, errs):
+                while not stop.is_set():
+                    try:
+                        sink.append(_fe_post(fe.url + "/predict",
+                                             {"model": "default",
+                                              "features": x}))
+                    except Exception as e:       # non-typed = failure
+                        errs.append(e)
+
+            ts = [threading.Thread(target=client, args=(results, errors))
+                  for _ in range(4)]
+            for t in ts:
+                t.start()
+            time.sleep(1.0)
+            procs[1].kill()                      # SIGKILL mid-storm
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                with fe._lock:
+                    if fe._replicas[1].state == DEAD:
+                        break
+                time.sleep(0.05)
+            time.sleep(1.0)                      # keep storming after
+            stop.set()
+            for t in ts:
+                t.join(timeout=30)
+            assert not errors, f"non-typed failures: {errors[:3]}"
+            assert results
+            codes = {c for c, _ in results}
+            assert codes <= {200, 429, 503}, codes
+            assert any(c == 200 for c, _ in results)
+            bad = [b for c, b in results
+                   if c != 200 and "reason" not in b
+                   and "error" not in b]
+            assert not bad, bad[:3]
+            with fe._lock:
+                assert fe._replicas[1].state == DEAD
+            evc = registry().counter("serving_replica_evictions_total",
+                                     "")
+            assert evc.total() >= 1
+
+            # -- phase 2: replacement rejoins ------------------------
+            from deeplearning4j_tpu.serving.federation import \
+                spawn_replica
+            env = dict(_FLEET_ENV)
+            env["DL4JTPU_REPLICA_CKPT_DIR"] = str(ckdir)
+            procs.append(spawn_replica(1, fe.url, env=env))
+            assert fe.wait_for_replicas(2, timeout=180)
+
+            # -- phase 3: rolling swap under live traffic ------------
+            # Swap candidates decode into the LIVE tree's template, so
+            # both are default_builder-shaped. NaN params first (the
+            # canary MUST reject it: the retained golden batch goes
+            # non-finite), then a real update (different seed: finite,
+            # promotable).
+            bad_net = _fleet_net()
+            bad_net.set_params(np.full(bad_net.num_params(), np.nan,
+                                       np.float32))
+            bad_net.iteration = 1
+            mgr.save(bad_net)
+
+            code, baseline = _fe_post(fe.url + "/predict",
+                                      {"model": "default",
+                                       "features": x})
+            assert code == 200
+
+            stop.clear()
+            results2, errors2 = [], []
+            ts = [threading.Thread(target=client,
+                                   args=(results2, errors2))
+                  for _ in range(3)]
+            for t in ts:
+                t.start()
+
+            # NaN checkpoint: canary rejects, fleet keeps old params
+            code, body = _fe_post(fe.url + "/swap",
+                                  {"model": "default"}, timeout=120.0)
+            assert code == 409, body
+            assert body["stage"] == "canary" and body["swapped"] == []
+            code, after_reject = _fe_post(
+                fe.url + "/predict", {"model": "default", "features": x})
+            assert code == 200
+            np.testing.assert_array_equal(          # bitwise restore
+                np.asarray(baseline["predictions"]),
+                np.asarray(after_reject["predictions"]))
+
+            # good checkpoint: canary + promote across the fleet
+            good = _fleet_net(seed=7)
+            good.iteration = 2
+            mgr.save(good)
+            code, body = _fe_post(fe.url + "/swap",
+                                  {"model": "default"}, timeout=240.0)
+            assert code == 200, body
+            assert body["canary"] in (0, 1)
+            assert sorted(body["swapped"]) == [0, 1]
+            stop.set()
+            for t in ts:
+                t.join(timeout=30)
+            assert not errors2, f"dropped requests: {errors2[:3]}"
+            bad2 = [b for c, b in results2
+                    if c != 200 and "reason" not in b
+                    and "error" not in b]
+            assert not bad2, bad2[:3]
+            code, after_swap = _fe_post(
+                fe.url + "/predict", {"model": "default", "features": x})
+            assert code == 200
+            assert not np.array_equal(
+                np.asarray(baseline["predictions"]),
+                np.asarray(after_swap["predictions"]))
+        finally:
+            _kill_fleet(procs)
+            fe.stop()
+
+    def test_env_armed_beat_fault_evicts_while_gateway_serves(
+            self, tmp_path):
+        """DL4JTPU_FAULT_REPLICA_BEAT in the child: beats 1-6 publish
+        (the replica joins and warms), then the channel goes dark.
+        The front-end evicts past timeout_s even though the replica
+        process is alive and serving."""
+        fe = FederationFrontEnd(
+            health=HealthConfig(interval_s=0.25, timeout_s=2.0))
+        fe.start()
+        procs = []
+        try:
+            procs = _spawn_fleet(
+                fe, 1, tmp_path / "ckpts",
+                extra_env={"DL4JTPU_FAULT_REPLICA_BEAT": "fail:7/1"})
+            with fe._lock:
+                url = fe._replicas[0].url
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                with fe._lock:
+                    if fe._replicas[0].state == DEAD:
+                        break
+                time.sleep(0.1)
+            with fe._lock:
+                assert fe._replicas[0].state == DEAD
+            # the replica's own gateway still serves — only its beat
+            # channel is partitioned
+            code, body = _fe_post(url + "/predict",
+                                  {"model": "default",
+                                   "features": rand_x(1).tolist()})
+            assert code == 200, body
+            # but the federation refuses to route to it
+            code, body = _fe_post(fe.url + "/predict",
+                                  {"model": "default",
+                                   "features": rand_x(1).tolist()})
+            assert code == 503 and body["reason"] == "replica_lost"
+        finally:
+            _kill_fleet(procs)
+            fe.stop()
