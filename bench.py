@@ -1929,6 +1929,7 @@ def _register_metric_families():
     from deeplearning4j_tpu.serving import decode as serving_decode
     from deeplearning4j_tpu.serving import federation as serving_federation
     from deeplearning4j_tpu.serving import flight_recorder
+    from deeplearning4j_tpu.serving import gateway as serving_gateway
     from deeplearning4j_tpu.serving import model_pool as serving_pool
     from deeplearning4j_tpu.serving import scheduler as serving_scheduler
     # Recovery counters (rollbacks/retries — docs/robustness.md),
@@ -1944,6 +1945,7 @@ def _register_metric_families():
     serving_federation.register_metrics()
     serving_scheduler.register_metrics()
     serving_pool.register_metrics()
+    serving_gateway.register_metrics()
     serving_autotuner.register_metrics()
     flight_recorder.register_metrics()
     cluster_health.register_metrics()

@@ -117,20 +117,36 @@ class Baseline:
 
     # -- (re)recording ----------------------------------------------------
     def record(self, findings: List[Finding],
-               default_justification: str = "grandfathered") -> None:
+               default_justification: str = "") -> None:
         """Replace entries with the given findings, preserving existing
-        justifications for fingerprints that survive."""
+        justifications for fingerprints that survive.
+
+        Every NEW entry must carry a justification — pass one via
+        ``default_justification`` (CLI: ``--justify``); recording an
+        entry with an empty justification raises ValueError instead of
+        silently grandfathering it."""
         old: Dict[str, List[BaselineEntry]] = {}
         for e in self.entries:
             old.setdefault(e.fingerprint, []).append(e)
         new_entries: List[BaselineEntry] = []
+        unjustified: List[str] = []
         for f in findings:
             kept = old.get(f.fingerprint)
             justification = default_justification
             if kept:
                 justification = kept.pop(0).justification or justification
+            if not justification.strip():
+                unjustified.append(f"{f.path}:{f.line} {f.rule}")
             new_entries.append(BaselineEntry(
                 fingerprint=f.fingerprint, rule=f.rule,
                 location=f"{f.path}:{f.line} [{f.symbol}]",
                 justification=justification))
+        if unjustified:
+            shown = "; ".join(unjustified[:5])
+            more = f" (+{len(unjustified) - 5} more)" \
+                if len(unjustified) > 5 else ""
+            raise ValueError(
+                f"refusing to baseline {len(unjustified)} finding(s) "
+                f"without a justification — pass one with --justify: "
+                f"{shown}{more}")
         self.entries = new_entries

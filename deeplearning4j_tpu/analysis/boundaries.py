@@ -223,6 +223,24 @@ def infer(tree: ast.AST, aliases: Optional[Dict[str, str]] = None) -> JitInfo:
                         arg.args[0].id in info.functions:
                     info.roots.add(info.functions[arg.args[0].id])
 
+    # ---- pass 1b: declared trace surfaces -------------------------------
+    # A module-level ``__traced__ = ("fn", ...)`` tuple names functions
+    # that are traced from ANOTHER file (cross-file jit wrapping the
+    # per-file passes above cannot see) — e.g. a kernel entry point
+    # jitted by its caller. Listed names become roots.
+    for stmt in getattr(tree, "body", []):
+        if not isinstance(stmt, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "__traced__"
+                   for t in stmt.targets):
+            continue
+        if isinstance(stmt.value, (ast.Tuple, ast.List)):
+            for elt in stmt.value.elts:
+                if isinstance(elt, ast.Constant) and \
+                        isinstance(elt.value, str) and \
+                        elt.value in info.functions:
+                    info.roots.add(info.functions[elt.value])
+
     # ---- pass 2: jit assignments (the lazy __getattr__ attribute map) --
     for node in ast.walk(tree):
         if not isinstance(node, ast.Assign) or \

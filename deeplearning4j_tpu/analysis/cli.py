@@ -4,7 +4,12 @@
 
     python -m deeplearning4j_tpu.analysis [paths...] \
         [--format text|json] [--baseline FILE] [--write-baseline] \
-        [--no-baseline] [--rules JL101,JL401] [--list-rules]
+        [--justify TEXT] [--no-baseline] [--rules [JL101,JL401]] \
+        [--list-rules]
+
+A bare ``--rules`` (no value) prints the rule catalog — id, severity,
+title, fix hint — and exits; with a comma-separated value it restricts
+the run to those rules.
 
 Exit codes: 0 = clean vs baseline, 1 = new findings, 2 = usage/config
 error. Defaults (paths, baseline) may come from ``[tool.jaxlint]`` in
@@ -66,9 +71,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="report every finding, ignore any baseline")
     p.add_argument("--write-baseline", action="store_true",
                    help="record the current findings as the new baseline "
-                        "(preserves justifications for surviving entries)")
-    p.add_argument("--rules", default=None,
-                   help="comma-separated rule ids to run (default: all)")
+                        "(preserves justifications for surviving entries; "
+                        "new entries require --justify)")
+    p.add_argument("--justify", default="",
+                   help="justification recorded on NEW baseline entries "
+                        "written by --write-baseline")
+    p.add_argument("--rules", nargs="?", const="", default=None,
+                   help="comma-separated rule ids to run (default: all); "
+                        "bare --rules prints the rule catalog with "
+                        "severity and fix hints, then exits")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule catalog and exit")
     return p
@@ -89,7 +100,7 @@ def _select_rules(spec: Optional[str]):
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
 
-    if args.list_rules:
+    if args.list_rules or args.rules == "":
         for r in rule_catalog():
             print(f"{r['id']}  {r['severity']:<7}  {r['title']:<18} "
                   f"{r['hint']}")
@@ -126,7 +137,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 2
 
     if args.write_baseline:
-        baseline.record(findings)
+        try:
+            baseline.record(findings,
+                            default_justification=args.justify)
+        except ValueError as exc:
+            print(f"jaxlint: {exc}", file=sys.stderr)
+            return 2
         baseline.save(baseline_path)
         print(f"jaxlint: wrote {len(baseline.entries)} baseline entries "
               f"to {baseline_path}")

@@ -9,7 +9,7 @@ Suppression syntax (same line as the finding)::
 
     self._stopped = True  # jaxlint: disable=JL401
     self.dropped += 1     # jaxlint: disable=JL401,JL101
-    self._flag = True     # jaxlint: atomic   (alias for disable=JL401)
+    self._flag = True     # jaxlint: atomic   (alias for disable=JL401,JL404)
     x = float(y)          # jaxlint: disable=all
 """
 from __future__ import annotations
@@ -39,7 +39,7 @@ def _parse_suppressions(lines: List[str]) -> Dict[int, Set[str]]:
         if not m:
             continue
         if m.group("atomic"):
-            out.setdefault(lineno, set()).add("JL401")
+            out.setdefault(lineno, set()).update({"JL401", "JL404"})
             continue
         ids = {tok.strip().upper() for tok in m.group("ids").split(",")
                if tok.strip()}

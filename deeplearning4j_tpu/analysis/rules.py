@@ -16,7 +16,16 @@ Rule families
   crash hashing) every call.
 * JL3xx  buffer donation misuse.
 * JL4xx  lock discipline in threaded subsystems (RacerD-style
-  consistent-guard checking).
+  consistent-guard checking): JL401 consistent guards over thread entry
+  points, JL402 lock-acquisition-order cycles (potential deadlocks),
+  JL403 blocking calls under a held lock, JL404 field-level atomicity
+  (shared attributes written under a lock but read or read-modify-
+  written outside it).
+* JL5xx  serving discipline: JL501 typed-error taxonomy at HTTP route
+  handlers, JL502 metrics-family discipline (hot-path construction,
+  unbounded label cardinality, missing ``bench --once``
+  pre-registration), JL503 fault-point chaos coverage (every
+  ``faults.fire`` literal must be exercised by a test and documented).
 
 Hotness is lexical: a function is *hot* if its name looks like a
 training/step/iterator path (or a listener callback), or if it is
@@ -25,6 +34,7 @@ nested inside one. Jit-reachability comes from :mod:`.boundaries`.
 from __future__ import annotations
 
 import ast
+import os
 import re
 from dataclasses import dataclass
 from typing import Callable, Dict, Iterator, List, Optional, Set, Tuple
@@ -709,6 +719,647 @@ def _check_lock_discipline(ctx):
 
 
 # --------------------------------------------------------------------------
+# JL402/JL403 — lock-acquisition graphs and blocking-under-lock
+# --------------------------------------------------------------------------
+
+#: primitives that are *acquired* (``with``/``.acquire()``), as opposed to
+#: queues/events which only block
+_ACQUIRABLE_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+                     "BoundedSemaphore"}
+
+
+def _module_lock_names(ctx) -> Set[str]:
+    out: Set[str] = set()
+    for stmt in getattr(ctx.tree, "body", []):
+        if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+            d = (ctx.dotted(stmt.value.func) or "").split(".")[-1]
+            if d in _ACQUIRABLE_CTORS:
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name):
+                        out.add(tgt.id)
+    return out
+
+
+def _class_lock_attrs(ctx, methods: Dict[str, ast.FunctionDef]) -> Set[str]:
+    """``self.<attr>`` names that hold sync primitives: assigned one in
+    ``__init__``, or lock-ish by name anywhere in the class."""
+    out = _sync_primitive_attrs(methods.get("__init__"), ctx)
+    for fn in methods.values():
+        for node in ast.walk(fn):
+            if _is_self_attr(node) and _LOCKISH.search(node.attr):
+                out.add(node.attr)
+    return out
+
+
+def _lock_identity(ctx, expr, cls_name: str, lock_attrs: Set[str],
+                   module_locks: Set[str]) -> Optional[str]:
+    """Stable name for a lock object resolved by attribute path:
+    ``Cls.attr`` for ``self.<lock>``, a dotted path for other attribute
+    chains whose last segment is lock-ish, the bare name for
+    module-level locks."""
+    if isinstance(expr, ast.Call):
+        expr = expr.func
+    if _is_self_attr(expr) and (expr.attr in lock_attrs
+                                or _LOCKISH.search(expr.attr)):
+        return f"{cls_name}.{expr.attr}" if cls_name else f"self.{expr.attr}"
+    if isinstance(expr, ast.Name) and (expr.id in module_locks
+                                       or _LOCKISH.search(expr.id)):
+        return expr.id
+    if isinstance(expr, ast.Attribute) and _LOCKISH.search(expr.attr):
+        d = ctx.dotted(expr)
+        if d:
+            return d
+    return None
+
+
+#: functions whose call under a held lock blocks on device/model work
+_FORWARDISH = {"output", "predict", "generate", "forward", "_forward"}
+#: queue-shaped receiver names for .get()/.put() blocking checks
+_QUEUEISH = re.compile(r"queue|(^|_)q($|_)", re.IGNORECASE)
+_SOCKETISH_METHODS = {"urlopen", "recv", "recv_into", "sendall",
+                      "getresponse", "accept", "makefile"}
+
+
+class _LockGraph:
+    """Held-lock statement walker over one class (or the module's
+    top-level functions).
+
+    Records (a) lock-order edges ``A -> B`` (B acquired while A held,
+    including one transitive level of same-scope callees, like
+    :mod:`.boundaries` does for jit roots) and (b) blocking calls made
+    while at least one lock is held."""
+
+    def __init__(self, ctx, cls_name: str,
+                 methods: Dict[str, ast.FunctionDef],
+                 lock_attrs: Set[str], module_locks: Set[str]):
+        self.ctx = ctx
+        self.cls_name = cls_name
+        self.methods = methods
+        self.lock_attrs = lock_attrs
+        self.module_locks = module_locks
+        self.edges: Dict[Tuple[str, str], ast.AST] = {}
+        self.blocking: List[Tuple[ast.AST, str, Tuple[str, ...]]] = []
+        self._summaries: Dict[str, Set[str]] = {}
+
+    def lock_of(self, expr) -> Optional[str]:
+        return _lock_identity(self.ctx, expr, self.cls_name,
+                              self.lock_attrs, self.module_locks)
+
+    def walk(self) -> "_LockGraph":
+        for _name, fn in sorted(self.methods.items()):
+            self._stmts(fn.body, [])
+        return self
+
+    # -- one-level callee summaries ---------------------------------------
+    def summary(self, name: str) -> Set[str]:
+        """Locks a callee acquires anywhere in its own body (memoised;
+        the one transitive level of the inter-procedural graph)."""
+        if name in self._summaries:
+            return self._summaries[name]
+        self._summaries[name] = set()          # recursion guard
+        acquired: Set[str] = set()
+        fn = self.methods.get(name)
+        if fn is not None:
+            for node in _walk_no_nested(fn):
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        lk = self.lock_of(item.context_expr)
+                        if lk:
+                            acquired.add(lk)
+                elif isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "acquire":
+                    lk = self.lock_of(node.func.value)
+                    if lk:
+                        acquired.add(lk)
+        self._summaries[name] = acquired
+        return acquired
+
+    # -- walking ----------------------------------------------------------
+    def _record(self, held: List[str], lock: str, node: ast.AST) -> None:
+        for h in held:
+            if h != lock:
+                self.edges.setdefault((h, lock), node)
+
+    def _stmts(self, body: List[ast.stmt], held: List[str]) -> None:
+        for stmt in body:
+            self._scan_exprs(stmt, held)
+            if isinstance(stmt, ast.With):
+                acquired: List[str] = []
+                for item in stmt.items:
+                    lk = self.lock_of(item.context_expr)
+                    if lk:
+                        self._record(held, lk, item.context_expr)
+                        acquired.append(lk)
+                self._stmts(stmt.body, held + acquired)
+            elif isinstance(stmt, ast.If):
+                self._stmts(stmt.body, list(held))
+                self._stmts(stmt.orelse, list(held))
+            elif isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                self._stmts(stmt.body, list(held))
+                self._stmts(stmt.orelse, list(held))
+            elif isinstance(stmt, ast.Try):
+                self._stmts(stmt.body, list(held))
+                for handler in stmt.handlers:
+                    self._stmts(handler.body, list(held))
+                self._stmts(stmt.orelse, list(held))
+                self._stmts(stmt.finalbody, list(held))
+            elif isinstance(stmt, ast.Expr) and isinstance(stmt.value,
+                                                           ast.Call):
+                # sequential .acquire()/.release() at this nesting level
+                call = stmt.value
+                if isinstance(call.func, ast.Attribute):
+                    lk = self.lock_of(call.func.value)
+                    if lk and call.func.attr == "acquire":
+                        self._record(held, lk, call)
+                        held.append(lk)
+                    elif lk and call.func.attr == "release" and lk in held:
+                        held.remove(lk)
+
+    def _scan_exprs(self, stmt: ast.stmt, held: List[str]) -> None:
+        """Calls in this statement's own expressions (tests, values,
+        arguments) — child statements are handled by :meth:`_stmts`."""
+        stack = [c for c in ast.iter_child_nodes(stmt)
+                 if not isinstance(c, ast.stmt)]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef, ast.stmt)):
+                continue
+            if isinstance(node, ast.Call):
+                self._call(node, held)
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _call(self, call: ast.Call, held: List[str]) -> None:
+        func = call.func
+        if isinstance(func, ast.Attribute) and func.attr == "acquire":
+            lk = self.lock_of(func.value)
+            if lk:
+                self._record(held, lk, call)
+            return
+        # one transitive callee level: locks the callee itself acquires
+        callee = None
+        if _is_self_attr(func) and func.attr in self.methods:
+            callee = func.attr
+        elif isinstance(func, ast.Name) and func.id in self.methods:
+            callee = func.id
+        if callee is not None and held:
+            for lk in sorted(self.summary(callee)):
+                self._record(held, lk, call)
+        if held:
+            reason = self._blocking_reason(call, held)
+            if reason:
+                self.blocking.append((call, reason, tuple(held)))
+
+    def _blocking_reason(self, call: ast.Call,
+                         held: List[str]) -> Optional[str]:
+        func = call.func
+        attr = func.attr if isinstance(func, ast.Attribute) else ""
+        d = self.ctx.dotted(func) or ""
+        kwnames = {kw.arg for kw in call.keywords}
+        if d == "time.sleep":
+            return "'time.sleep' call"
+        if attr == "block_until_ready":
+            return "host fence '.block_until_ready()'"
+        if d.split(".")[0] == "subprocess":
+            return f"subprocess call '{d}'"
+        if d.startswith(("urllib.", "requests.", "socket.")) or \
+                attr in _SOCKETISH_METHODS:
+            return "socket/HTTP I/O"
+        recv = func.value if isinstance(func, ast.Attribute) else None
+        rname = _name_of(recv) if recv is not None else ""
+        if _QUEUEISH.search(rname or ""):
+            if attr == "get" and not call.args and "timeout" not in kwnames:
+                return f"blocking '{rname}.get()' without timeout"
+            if attr == "put" and "timeout" not in kwnames and \
+                    "block" not in kwnames:
+                return f"blocking '{rname}.put()' without timeout"
+        if attr == "wait" and not call.args and "timeout" not in kwnames:
+            rid = self.lock_of(recv) if recv is not None else None
+            if [h for h in held if h != rid]:
+                return "'.wait()' without timeout"
+        if attr in _FORWARDISH:
+            return f"model forward '.{attr}()'"
+        return None
+
+
+def _lock_graphs(ctx) -> List[_LockGraph]:
+    module_locks = _module_lock_names(ctx)
+    mod_fns = {n.name: n for n in getattr(ctx.tree, "body", [])
+               if isinstance(n, ast.FunctionDef)}
+    graphs = [_LockGraph(ctx, "", mod_fns, set(), module_locks)]
+    for cls in ctx.classes():
+        methods = {n.name: n for n in cls.body
+                   if isinstance(n, ast.FunctionDef)}
+        graphs.append(_LockGraph(ctx, cls.name, methods,
+                                 _class_lock_attrs(ctx, methods),
+                                 module_locks))
+    return [g.walk() for g in graphs]
+
+
+def find_cycles(edges) -> List[List[str]]:
+    """Simple cycles in a lock-order graph, each reported once, rooted
+    at its lexicographically smallest lock. ``edges`` is any iterable of
+    ``(from, to)`` pairs (a dict of edge->site works directly)."""
+    adj: Dict[str, Set[str]] = {}
+    for a, b in edges:
+        adj.setdefault(a, set()).add(b)
+    out: List[List[str]] = []
+    seen: Set[Tuple[str, ...]] = set()
+
+    def dfs(start: str, node: str, path: List[str],
+            onpath: Set[str]) -> None:
+        for nxt in sorted(adj.get(node, ())):
+            if nxt == start:
+                canon = tuple(path)
+                if canon not in seen:
+                    seen.add(canon)
+                    out.append(list(path))
+            elif nxt not in onpath and nxt > start:
+                path.append(nxt)
+                onpath.add(nxt)
+                dfs(start, nxt, path, onpath)
+                path.pop()
+                onpath.discard(nxt)
+
+    for start in sorted(adj):
+        dfs(start, start, [start], {start})
+    return out
+
+
+def lock_edges_from_source(source: str,
+                           path: str = "<string>") -> Dict[Tuple[str, str],
+                                                           ast.AST]:
+    """The static lock-acquisition-order graph of one source file, as an
+    edge ``(held, acquired) -> acquisition site`` map — the static half
+    of the :mod:`.lockcheck` runtime cross-check."""
+    from .engine import FileContext
+    tree = ast.parse(source)
+    ctx = FileContext(path, source, tree)
+    edges: Dict[Tuple[str, str], ast.AST] = {}
+    for g in _lock_graphs(ctx):
+        edges.update(g.edges)
+    return edges
+
+
+def _check_lock_order(ctx):
+    for g in _lock_graphs(ctx):
+        for cycle in find_cycles(g.edges):
+            if len(cycle) < 2:
+                continue
+            node = g.edges.get((cycle[0], cycle[1]))
+            if node is None:
+                continue
+            ring = " -> ".join(cycle + [cycle[0]])
+            yield node, (f"cyclic lock acquisition order {ring}: two "
+                         f"threads taking these locks in opposite order "
+                         f"can deadlock")
+
+
+def _check_blocking_under_lock(ctx):
+    for g in _lock_graphs(ctx):
+        for node, reason, held in g.blocking:
+            locks = ", ".join(sorted(set(held)))
+            yield node, (f"{reason} while holding {locks} — blocking "
+                         f"inside a critical section wedges every waiter")
+
+
+# --------------------------------------------------------------------------
+# JL404 — field-level atomicity
+# --------------------------------------------------------------------------
+
+def _check_field_atomicity(ctx):
+    for cls in ctx.classes():
+        methods = {n.name: n for n in cls.body
+                   if isinstance(n, ast.FunctionDef)}
+        if not methods:
+            continue
+        sync_attrs = _class_lock_attrs(ctx, methods)
+        owns_locks = any(_LOCKISH.search(a) for a in sync_attrs) or \
+            bool(_sync_primitive_attrs(methods.get("__init__"), ctx))
+
+        # (attr, node, kind, method, guard)
+        events: List[Tuple[str, ast.AST, str, str, Optional[str]]] = []
+        for mname, fn in methods.items():
+            if mname.endswith("_locked"):
+                continue      # caller-holds-lock convention
+            for node in _walk_no_nested(fn):
+                if isinstance(node, (ast.Assign, ast.AugAssign,
+                                     ast.AnnAssign)):
+                    tgts = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    for tgt in tgts:
+                        subs = list(tgt.elts) if isinstance(
+                            tgt, (ast.Tuple, ast.List)) else [tgt]
+                        for s in subs:
+                            if _is_self_attr(s) and \
+                                    not s.attr.startswith("__"):
+                                kind = "rmw" if isinstance(
+                                    node, ast.AugAssign) else "write"
+                                events.append((s.attr, s, kind, mname,
+                                               _guard_of(ctx, s)))
+                elif isinstance(node, (ast.If, ast.While)):
+                    for sub in ast.walk(node.test):
+                        if _is_self_attr(sub) and \
+                                isinstance(sub.ctx, ast.Load) and \
+                                not sub.attr.startswith("__"):
+                            events.append((sub.attr, sub, "test-read",
+                                           mname, _guard_of(ctx, sub)))
+
+        by_attr: Dict[str, List] = {}
+        for attr, node, kind, mname, guard in events:
+            by_attr.setdefault(attr, []).append((node, kind, mname, guard))
+
+        for attr, evs in sorted(by_attr.items()):
+            if attr in sync_attrs:
+                continue
+            guarded = sorted({g for n, k, m, g in evs
+                              if g and m != "__init__"
+                              and k in ("write", "rmw")})
+            for node, kind, mname, guard in evs:
+                if mname == "__init__" or guard is not None:
+                    continue
+                if kind == "rmw" and (owns_locks or guarded):
+                    yield node, (
+                        f"unguarded read-modify-write of 'self.{attr}' in "
+                        f"'{mname}' of lock-owning class '{cls.name}' — "
+                        f"lost-update race (the 'dropped += 1' shape)")
+                elif kind == "write" and guarded:
+                    yield node, (
+                        f"'self.{attr}' is written under "
+                        f"{'/'.join(guarded)} elsewhere in '{cls.name}' "
+                        f"but written without it in '{mname}'")
+                elif kind == "test-read" and guarded:
+                    yield node, (
+                        f"check-then-act read of 'self.{attr}' in "
+                        f"'{mname}' without {'/'.join(guarded)} (it is "
+                        f"written under that lock) — the value can change "
+                        f"between the test and the action")
+
+
+# --------------------------------------------------------------------------
+# JL5xx — serving discipline
+# --------------------------------------------------------------------------
+
+#: the typed serving-error taxonomy allowed to escape an HTTP handler
+ERROR_TAXONOMY = {
+    "ServerClosedError", "BatchExecutionError", "NonFiniteOutputError",
+    "QueueFullError", "DeadlineExceededError", "DecodeStepError",
+    "KVCacheExhaustedError", "BreakerOpenError", "TierShedError",
+    "SwapError", "ReplicaLostError", "FaultInjected",
+}
+
+#: self.* calls that raise typed serving errors (must sit inside a try)
+_ROUTE_RAISING_CALLS = {"predict", "generate", "swap", "dispatch", "get",
+                        "reconfigure", "reconfigure_scheduler",
+                        "eject_member", "remove", "admit"}
+
+
+def _try_protected(ctx, node, fn) -> bool:
+    """Is this node inside the *body* of a try that has handlers (not in
+    a handler/else/finally, which run unprotected)?"""
+    child, cur = node, ctx.parent(node)
+    while cur is not None:
+        if isinstance(cur, ast.Try) and cur.handlers and child in cur.body:
+            return True
+        if cur is fn:
+            return False
+        child, cur = cur, ctx.parent(cur)
+    return False
+
+
+def _check_route_typed_errors(ctx):
+    for fn in ctx.functions():
+        name = getattr(fn, "name", "")
+        if not name.endswith("_route"):
+            continue
+        for node in _walk_no_nested(fn):
+            if isinstance(node, ast.Raise) and node.exc is not None:
+                exc = node.exc
+                if isinstance(exc, ast.Call):
+                    exc = exc.func
+                ename = _name_of(exc)
+                if ename and ename not in ERROR_TAXONOMY and \
+                        not _try_protected(ctx, node, fn):
+                    yield node, (
+                        f"raise of non-taxonomy '{ename}' escapes HTTP "
+                        f"handler '{name}' untyped — clients see a bare "
+                        f"500 instead of a typed serving error")
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute):
+                attr = node.func.attr
+                if attr not in _ROUTE_RAISING_CALLS:
+                    continue
+                d = ctx.dotted(node.func) or ""
+                if not d.startswith("self."):
+                    continue
+                if attr == "get" and d != "self.pool.get":
+                    continue
+                if not _try_protected(ctx, node, fn):
+                    yield node, (
+                        f"call to '{d}' outside any try in HTTP handler "
+                        f"'{name}' — a typed serving error raised here "
+                        f"escapes as an untyped 500")
+
+
+# --- JL502: metrics discipline --------------------------------------------
+
+_METRIC_FACTORIES = {"counter", "gauge", "histogram"}
+_UNBOUNDED_LABELS = {"request_id", "rid", "uuid", "guid", "trace_id",
+                     "span_id", "correlation_id", "port", "pid", "tid"}
+_UNBOUNDED_VALUE_CALLS = {"uuid4", "uuid1", "getpid", "get_ident"}
+_REGISTER_FN_RE = re.compile(r"register.*metrics")
+
+
+def _metric_family_call(ctx, node) -> Optional[str]:
+    """Family name if this call constructs a metric family on a
+    registry-ish receiver, else None."""
+    if not (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _METRIC_FACTORIES
+            and node.args and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)):
+        return None
+    recv = node.func.value
+    if isinstance(recv, ast.Call):
+        recv = recv.func
+    if re.search(r"reg", _name_of(recv) or "", re.IGNORECASE):
+        return node.args[0].value
+    return None
+
+
+def _package_root(path: str) -> Optional[str]:
+    """Ascend from a file path to the ``deeplearning4j_tpu`` package dir
+    (None when analyzing sources outside a checkout)."""
+    cur = os.path.abspath(path)
+    while True:
+        if os.path.basename(cur) == "deeplearning4j_tpu" and \
+                os.path.isdir(cur):
+            return cur
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return None
+        cur = parent
+
+
+def _tree_files(root: str) -> List[str]:
+    out: List[str] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        out.extend(os.path.join(dirpath, f) for f in sorted(filenames))
+    return out
+
+
+_PREREG_CACHE: Dict[str, frozenset] = {}
+
+
+def _preregistered_families(pkg_root: str) -> frozenset:
+    """Every string constant inside a ``register*metrics`` function in
+    the package or the repo-root ``bench.py`` — the families a
+    ``bench --once`` scrape pre-registers before any traffic."""
+    cached = _PREREG_CACHE.get(pkg_root)
+    if cached is not None:
+        return cached
+    names: Set[str] = set()
+    files = [f for f in _tree_files(pkg_root) if f.endswith(".py")]
+    bench = os.path.join(os.path.dirname(pkg_root), "bench.py")
+    if os.path.isfile(bench):
+        files.append(bench)
+    for fname in files:
+        try:
+            with open(fname, "r", encoding="utf-8") as fh:
+                tree = ast.parse(fh.read())
+        except (OSError, SyntaxError, UnicodeDecodeError):
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and _REGISTER_FN_RE.search(node.name):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Constant) and \
+                            isinstance(sub.value, str):
+                        names.add(sub.value)
+    out = frozenset(names)
+    _PREREG_CACHE[pkg_root] = out
+    return out
+
+
+def _check_metrics_discipline(ctx):
+    # (a) family construction reachable from a hot path
+    for fn in ctx.hot_functions():
+        fname = getattr(fn, "name", "<lambda>")
+        if _REGISTER_FN_RE.search(fname):
+            continue
+        for node in _walk_no_nested(fn):
+            fam = _metric_family_call(ctx, node)
+            if fam:
+                yield node, (
+                    f"metric family '{fam}' constructed in hot function "
+                    f"'{fname}' — construct once in register_metrics() "
+                    f"and only .labels().inc() on the hot path")
+    # (b) unbounded-cardinality label sets
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "labels"):
+            continue
+        for kw in node.keywords:
+            if kw.arg and kw.arg.lower() in _UNBOUNDED_LABELS:
+                yield kw.value, (
+                    f"metric label '{kw.arg}' is unbounded-cardinality "
+                    f"(per-request identity) — every value mints a new "
+                    f"series and the scrape grows without bound")
+            elif isinstance(kw.value, ast.Call) and \
+                    _name_of(kw.value.func) in _UNBOUNDED_VALUE_CALLS:
+                yield kw.value, (
+                    f"metric label '{kw.arg}' is fed from "
+                    f"'{_name_of(kw.value.func)}()' — unbounded "
+                    f"cardinality mints a new series per value")
+    # (c) serving families absent from bench --once pre-registration
+    if "serving" not in os.path.normpath(ctx.path).split(os.sep):
+        return
+    pkg = _package_root(ctx.path)
+    if pkg is None:
+        return
+    prereg = _preregistered_families(pkg)
+    if not prereg:
+        return
+    for node in ast.walk(ctx.tree):
+        fam = _metric_family_call(ctx, node)
+        if fam is None or fam in prereg:
+            continue
+        encl = ctx.enclosing_function(node)
+        if encl is not None and \
+                _REGISTER_FN_RE.search(getattr(encl, "name", "")):
+            continue
+        yield node, (
+            f"metric family '{fam}' used in serving/ but absent from "
+            f"every register_metrics() pre-registration — a bench "
+            f"--once scrape misses it until first use")
+
+
+# --- JL503: fault-point coverage ------------------------------------------
+
+_CORPUS_CACHE: Dict[Tuple[str, str], str] = {}
+
+
+def _corpus(repo_root: str, sub: str, exts: Tuple[str, ...]) -> str:
+    key = (repo_root, sub)
+    cached = _CORPUS_CACHE.get(key)
+    if cached is not None:
+        return cached
+    chunks: List[str] = []
+    root = os.path.join(repo_root, sub)
+    if os.path.isdir(root):
+        for fname in _tree_files(root):
+            if fname.endswith(exts):
+                try:
+                    with open(fname, "r", encoding="utf-8") as fh:
+                        chunks.append(fh.read())
+                except (OSError, UnicodeDecodeError):
+                    continue
+    out = "\n".join(chunks)
+    _CORPUS_CACHE[key] = out
+    return out
+
+
+def _fault_env_var(point: str) -> str:
+    return "DL4JTPU_FAULT_" + point.upper().replace(".", "_").replace(
+        "-", "_")
+
+
+def _check_fault_coverage(ctx):
+    pkg = _package_root(ctx.path)
+    if pkg is None:
+        return
+    root = os.path.dirname(pkg)
+    tests = _corpus(root, "tests", (".py",))
+    docs = _corpus(root, "docs", (".md",))
+    if not tests or not docs:
+        return
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("fire", "check")
+                and node.args and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            continue
+        point = node.args[0].value
+        if "." not in point:
+            continue
+        if node.func.attr == "check" and not re.search(
+                r"fault", _name_of(node.func.value) or "", re.IGNORECASE):
+            continue          # '.check' is a common name; require faults.*
+        if point not in tests and _fault_env_var(point) not in tests:
+            yield node, (
+                f"fault point '{point}' is not exercised by any test "
+                f"under tests/ — the chaos hook can silently rot")
+        if point not in docs:
+            yield node, (
+                f"fault point '{point}' is missing from the docs fault "
+                f"tables (docs/*.md)")
+
+
+# --------------------------------------------------------------------------
 # registry
 # --------------------------------------------------------------------------
 
@@ -761,6 +1412,32 @@ RULES: Tuple[Rule, ...] = (
          "Guard every write with the same self.<lock>, or annotate a "
          "documented atomic with '# jaxlint: atomic'.",
          _check_lock_discipline),
+    Rule("JL402", "error", "lock-order-cycle",
+         "Acquire locks in one global order everywhere; break the cycle, "
+         "or baseline it with a justification if it cannot manifest.",
+         _check_lock_order),
+    Rule("JL403", "warning", "blocking-under-lock",
+         "Move the blocking call outside the critical section, or give it "
+         "a timeout so waiters cannot wedge behind it.",
+         _check_blocking_under_lock),
+    Rule("JL404", "warning", "field-atomicity",
+         "Take the guarding lock for every read-modify-write and "
+         "check-then-act on shared fields, or annotate a documented "
+         "atomic with '# jaxlint: atomic'.",
+         _check_field_atomicity),
+    Rule("JL501", "error", "untyped-route-error",
+         "Wrap handler work in try/except and map failures to the typed "
+         "serving taxonomy (QueueFullError, ServerClosedError, ...).",
+         _check_route_typed_errors),
+    Rule("JL502", "warning", "metrics-discipline",
+         "Construct metric families once in register_metrics(), keep "
+         "label sets bounded, and pre-register serving families so "
+         "bench --once scrapes see them.",
+         _check_metrics_discipline),
+    Rule("JL503", "error", "fault-coverage",
+         "Add a test that arms the point (faults.inject/injected) and a "
+         "row to the docs fault table.",
+         _check_fault_coverage),
 )
 
 RULES_BY_ID: Dict[str, Rule] = {r.id: r for r in RULES}

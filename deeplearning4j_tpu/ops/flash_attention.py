@@ -57,6 +57,11 @@ import jax.numpy as jnp
 
 from .pallas_kernels import kernel_probe, pad_axis_to
 
+# Cross-file trace surface (analysis/boundaries.py): decode_attention is
+# dispatched inside jitted decode steps (serving/decode.py _step_pure),
+# so the JL0xx/JL2xx purity rules must treat it as a traced root here.
+__traced__ = ("decode_attention",)
+
 NEG = -1e30  # mask sentinel; matches ops/attention.py (finite: -inf NaNs grads)
 
 DEFAULT_BLOCK_Q = 128

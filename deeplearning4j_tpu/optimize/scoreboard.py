@@ -244,7 +244,8 @@ class ChildWatchdog:
         self.extended = False
 
     def observe(self, beat: Optional[Dict[str, Any]] = None) -> None:
-        self.heartbeats += 1
+        # single writer: only the parent's beat-reader thread calls this
+        self.heartbeats += 1  # jaxlint: atomic
         self.last_beat = beat
         self._last_activity = self._clock()
 

@@ -482,6 +482,7 @@ class ClusterHealthMonitor:
             b.get("grace") for k, b in beats.items()
             if int(k) != self.process_id)
         my_fresh = now_local - self._step_changed_at <= cfg.stall_timeout_s
+        started_at = self._started_at      # one snapshot per evaluation
         lost: List[int] = []
         lost_ages: List[float] = []
         stalled: List[int] = []
@@ -492,8 +493,8 @@ class ClusterHealthMonitor:
             if b is None:
                 # startup grace: a peer that has NEVER beaten is only
                 # lost once the cluster has had timeout_s to assemble
-                if self._started_at is not None and \
-                        now_local - self._started_at > cfg.timeout_s:
+                if started_at is not None and \
+                        now_local - started_at > cfg.timeout_s:
                     lost.append(pid)
                     lost_ages.append(float("inf"))
                 continue

@@ -169,6 +169,10 @@ class ParallelInference:
         self.total_forwards = 0
         self.total_shed = 0
         self.total_batch_failures = 0
+        # Stats counters are bumped from caller threads (shed paths,
+        # SEQUENTIAL forwards) and the collector concurrently; a bare
+        # += would lose updates, so they share one cheap guard.
+        self._stats_lock = threading.Lock()
         # EWMA of one coalesced forward's wall time (written under
         # self._lock right after the forward it measures; the admission
         # estimate reads it lock-free — a stale float is fine there).
@@ -304,7 +308,9 @@ class ParallelInference:
         if x.ndim == 0:
             raise ValueError("Request must have a leading batch dimension")
         if self.inference_mode == InferenceMode.SEQUENTIAL:
-            if self._shutdown:
+            with self._enqueue_lock:
+                closed = self._shutdown
+            if closed:
                 raise ServerClosedError(
                     "ParallelInference has been shut down")
             with self._lock:
@@ -320,7 +326,9 @@ class ParallelInference:
                             # in SEQUENTIAL mode so no queue/pack phases
                             trace.mark("sched_wait")
                             trace.mark("dispatch")
-                        out = self._forward(x)
+                        # swap-pause design: _lock held through the
+                        # forward so hot-swap can quiesce the device
+                        out = self._forward(x)  # jaxlint: disable=JL403
                         if trace is not None:
                             out = np.asarray(out)  # recorder result fence
                             trace.mark("device")
@@ -354,7 +362,8 @@ class ParallelInference:
         return req.result
 
     def _shed(self, req: _Request, reason: str) -> None:
-        self.total_shed += 1
+        with self._stats_lock:
+            self.total_shed += 1
         cb = self.on_shed
         if cb is not None:
             try:
@@ -411,7 +420,8 @@ class ParallelInference:
             err.__cause__ = e
         if reqs is not None and not hasattr(err, "request_tags"):
             err.request_tags = [r.tag for r in reqs]
-        self.total_batch_failures += 1
+        with self._stats_lock:
+            self.total_batch_failures += 1
         cb = self.on_batch_error
         if cb is not None:
             try:
@@ -461,7 +471,9 @@ class ParallelInference:
                 try:
                     first = self._queue.get(timeout=0.1)
                 except queue.Empty:
-                    if self._shutdown:
+                    # Unlocked poll of a monotonic flag: worst case is
+                    # one extra 0.1 s get() before the sentinel lands.
+                    if self._shutdown:  # jaxlint: atomic
                         return
                     continue
             if first is None:  # shutdown sentinel: serve stragglers, exit
@@ -575,7 +587,8 @@ class ParallelInference:
                             r.trace.mark("dispatch")
                             if po:
                                 r.trace.ctx["sched_passovers"] = po
-                    out = self._forward(xs)
+                    # swap-pause design: _lock held through the forward
+                    out = self._forward(xs)  # jaxlint: disable=JL403
                     if traced:
                         # recorder-only result fence INSIDE the slot so
                         # device compute is charged to the slot it used;
@@ -592,7 +605,8 @@ class ParallelInference:
                     else 0.8 * self._ewma_batch_s + 0.2 * dur
             self._require_finite(out)
             self.executed_batch_sizes.append(n)
-            self.total_forwards += 1
+            with self._stats_lock:
+                self.total_forwards += 1
             cb = self.on_batch
             if cb is not None:
                 try:
@@ -647,7 +661,9 @@ class ParallelInference:
                 try:
                     first = self._queue.get(timeout=0.1)
                 except queue.Empty:
-                    if self._shutdown:
+                    # Unlocked poll of a monotonic flag: worst case is
+                    # one extra 0.1 s get() before the sentinel lands.
+                    if self._shutdown:  # jaxlint: atomic
                         return
                     continue
             if first is None:  # shutdown sentinel: serve stragglers, exit
@@ -709,7 +725,8 @@ class ParallelInference:
             self._run_packed(batch)
 
     def _note_pack_fallback(self, n: int) -> None:
-        self.total_pack_fallbacks += n
+        with self._stats_lock:
+            self.total_pack_fallbacks += n
         from ..data.padding import record_packing
         record_packing("serve", fallbacks=n)
 
@@ -768,7 +785,9 @@ class ParallelInference:
                             if po:
                                 r.trace.ctx["sched_passovers"] = po
                     faults.fire("serve.forward")
-                    out = self.model.output(xs, features_mask=segmask)
+                    # swap-pause design: _lock held through the forward
+                    out = self.model.output(  # jaxlint: disable=JL403
+                        xs, features_mask=segmask)
                     if traced:
                         out = np.asarray(out)  # recorder result fence
                         td = time.perf_counter()
@@ -779,8 +798,9 @@ class ParallelInference:
                     else 0.8 * self._ewma_batch_s + 0.2 * dur
             self._require_finite(out)
             self.executed_batch_sizes.append(len(batch))
-            self.total_forwards += 1
-            self.total_packed_requests += len(batch)
+            with self._stats_lock:
+                self.total_forwards += 1
+                self.total_packed_requests += len(batch)
             from ..data.padding import record_packing
             record_packing("serve", items=len(batch), real_tokens=ofs,
                            padded_tokens=self.pack_bucket)
@@ -837,11 +857,14 @@ class ParallelInference:
                 already = True
             else:
                 self._shutdown = True
-                if self._worker is not None:
-                    # May briefly block if the queue is full; the collector
-                    # keeps draining without this lock, so it always frees
-                    # up.
-                    self._queue.put(None)
+        if not already and self._worker is not None:
+            # Sentinel goes in OUTSIDE the lock: with a full queue this
+            # put blocks until the collector drains a slot, and holding
+            # _enqueue_lock through that window would wedge every
+            # enqueuer (and any concurrent shutdown) behind a blocked
+            # close. Admission is already fenced: _shutdown is set, so
+            # new requests fail typed before touching the queue.
+            self._queue.put(None)
         if self._worker is not None and not already:
             self._worker.join(timeout=join_timeout)
         # After the join window nothing will ever serve these — and on a

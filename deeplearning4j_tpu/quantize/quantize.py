@@ -54,6 +54,11 @@ __all__ = [
     "matmul_any",
 ]
 
+# Cross-file trace surface (analysis/boundaries.py): dense_qforward is
+# jitted by the serving layers that build quantized forwards, so the
+# JL0xx/JL2xx purity rules must treat it as a traced root here.
+__traced__ = ("dense_qforward",)
+
 #: reserved keys a quantized dense dict carries instead of ``W``
 QUANT_WEIGHT = "W_q"
 QUANT_SCALE = "W_scale"
@@ -253,7 +258,9 @@ def dense_qforward(params, x):
     x_scale = jnp.where(amax > 0, amax / 127.0, 1.0)
     x_q = jnp.clip(jnp.round(x / x_scale), -127, 127).astype(jnp.int8)
     acc = pallas_kernels.quant_matmul(x_q, w_q)
-    if QUANT_ZERO in params:
+    # Dict-key membership is pytree *structure*, static at trace time —
+    # not a tracer-value branch.
+    if QUANT_ZERO in params:  # jaxlint: disable=JL005
         rowsum = jnp.sum(x_q.astype(jnp.int32), axis=-1, keepdims=True)
         acc = acc + params[QUANT_ZERO][None, :] * rowsum
     out = acc.astype(jnp.float32) * (x_scale * scale[None, :])

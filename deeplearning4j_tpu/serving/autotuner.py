@@ -763,7 +763,9 @@ class AutoTuner:
                    after_score=round(after, 4), reverted=reverted,
                    evidence=report.evidence())
 
-    def _pick_knob(self, report: MonitorReport) -> Optional[Knob]:
+    def _pick_knob_locked(self, report: MonitorReport) -> Optional[Knob]:
+        # _locked suffix: only ever called from _try_move_locked, with
+        # the tuner lock held — _rotation is guarded by the caller.
         worst = report.worst
         if worst is not None and worst.top_phase:
             prefs: List[Knob] = []
@@ -785,7 +787,7 @@ class AutoTuner:
         return k
 
     def _try_move_locked(self, report: MonitorReport) -> None:
-        knob = self._pick_knob(report)
+        knob = self._pick_knob_locked(report)
         if knob is None:
             return
         new, raw, cur = knob.propose()

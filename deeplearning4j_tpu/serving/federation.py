@@ -805,7 +805,8 @@ class ReplicaServer:
             try:
                 self.beat_once()
             except Exception as e:  # never kill the publisher
-                self.beat_failures += 1
+                # single writer: only this beat thread ever bumps it
+                self.beat_failures += 1  # jaxlint: atomic
                 log.debug("replica %d beat failed: %s",
                           self.replica_id, e)
             self._stop_evt.wait(self.interval_s)

@@ -111,6 +111,28 @@ def _percentile(sorted_vals, q: float) -> float:
     return float(sorted_vals[idx])
 
 
+def register_metrics() -> None:
+    """Pre-register the gateway's request/latency families (bench
+    --once): a scrape taken before any traffic must already show them."""
+    reg = registry()
+    reg.counter("serving_requests_total",
+                "Gateway requests by terminal status (ok/shed/error)")
+    reg.counter("serving_admitted_total",
+                "Requests admitted past SLO/backpressure checks")
+    reg.counter("serving_shed_total",
+                "Requests shed before a forward served them, by reason")
+    reg.histogram("serving_latency_ms",
+                  "End-to-end request latency through the gateway",
+                  buckets=LATENCY_BUCKETS_MS)
+    reg.gauge("serving_latency_p50_ms",
+              "p50 gateway latency over the recent window")
+    reg.gauge("serving_latency_p99_ms",
+              "p99 gateway latency over the recent window")
+    reg.gauge("serving_tier_p99_ms",
+              "p99 gateway latency per priority tier over the recent "
+              "window (compare against serving_tier_slo_ms)")
+
+
 class ServingGateway(JsonHttpServer):
     """HTTP + in-process serving facade over a ModelPool.
 
@@ -600,6 +622,9 @@ class ServingGateway(JsonHttpServer):
         try:
             out = self.predict(name, x, deadline_ms=deadline_ms,
                                _trace_sink=sink)
+            # inside the try: a concurrent remove() between the forward
+            # and this lookup must surface as the typed 404, not a 500
+            version = self.pool.get(name).version.get("file", "initial")
         except KeyError as e:
             return 404, {"status": "error", "error": str(e)}
         except BreakerOpenError as e:
@@ -622,9 +647,7 @@ class ServingGateway(JsonHttpServer):
                          "error": str(e)}
         except ServerClosedError as e:
             return 503, {"status": "error", "error": str(e)}
-        entry = self.pool.get(name)
-        resp = {"status": "ok", "model": name,
-                "version": entry.version.get("file", "initial"),
+        resp = {"status": "ok", "model": name, "version": version,
                 "predictions": np.asarray(out).tolist()}
         if sink:
             resp["trace"] = sink[0]
@@ -647,6 +670,9 @@ class ServingGateway(JsonHttpServer):
                 name, req["prompt"],
                 max_new_tokens=int(req.get("max_new_tokens", 32)),
                 deadline_ms=deadline_ms, _trace_sink=sink)
+            # inside the try: a concurrent remove() between the decode
+            # and this lookup must surface as the typed 404, not a 500
+            version = self.pool.get(name).version.get("file", "initial")
         except KeyError as e:
             return 404, {"status": "error", "error": str(e)}
         except ValueError as e:
@@ -676,9 +702,7 @@ class ServingGateway(JsonHttpServer):
                          "error": str(e)}
         except ServerClosedError as e:
             return 503, {"status": "error", "error": str(e)}
-        entry = self.pool.get(name)
-        resp = {"status": "ok", "model": name,
-                "version": entry.version.get("file", "initial"),
+        resp = {"status": "ok", "model": name, "version": version,
                 "tokens": np.asarray(out).tolist()}
         if sink:
             resp["trace"] = sink[0]

@@ -109,13 +109,30 @@ def _fused_fallback_counter(reason: str, n: int = 1):
 
 
 def register_metrics() -> None:
-    """Pre-register the pool's fused-serving families (bench --once)."""
-    fam = registry().counter(
+    """Pre-register every pool-owned family (bench --once): a scrape
+    taken before the first request must already show them at zero."""
+    reg = registry()
+    fam = reg.counter(
         "serving_fused_fallback_total",
         "Members served per-model instead of fused, by reason "
         "(ineligible/ejected/dissolved)")
     for reason in ("ineligible", "ejected", "dissolved"):
         fam.labels(reason=reason)
+    reg.counter("serving_shed_total",
+                "Requests shed before a forward served them, by reason")
+    reg.counter("serving_forwards_total",
+                "Coalesced forward passes executed")
+    reg.counter("serving_rows_total",
+                "Real (un-padded) request rows served")
+    reg.histogram("serving_batch_rows",
+                  "Real rows per coalesced forward (bucket fill)")
+    reg.counter("serving_swaps_total",
+                "Checkpoint hot-swap attempts by outcome "
+                "(ok/noop/failed/canary_rejected) and target precision")
+    reg.gauge("serving_precision",
+              "Active serving precision per model (1 = the labeled "
+              "precision is live)")
+    reg.gauge("serving_queue_depth", "Requests queued per served model")
 
 
 def _golden_forward(model, golden: np.ndarray) -> np.ndarray:

@@ -366,7 +366,9 @@ class DeviceScheduler:
                       and o.depth_fn is not None]
         for o in others:
             try:
-                if int(o.depth_fn()) >= self.shed_depth:
+                # deliberate unlocked read of a config int: depth
+                # sampling happens outside _cv by design (see above)
+                if int(o.depth_fn()) >= self.shed_depth:  # jaxlint: atomic
                     return "tier_shed"
             except Exception:
                 continue  # a broken gauge must never shed traffic

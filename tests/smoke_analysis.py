@@ -18,11 +18,50 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
+def _lockcheck_smoke() -> int:
+    """Cheap lockcheck exercise: record one two-lock nesting, confirm
+    the runtime edge matches the static JL402 graph. Pure threading
+    bookkeeping — no device work."""
+    import textwrap
+    import threading
+
+    from deeplearning4j_tpu.analysis import lockcheck
+    from deeplearning4j_tpu.analysis import rules
+
+    src = textwrap.dedent("""
+        import threading
+        class Pair:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+            def ab(self):
+                with self._a:
+                    with self._b:
+                        pass
+    """)
+    with lockcheck.recording():
+        ns = {}
+        exec(src, ns)
+        p = ns["Pair"]()
+        lockcheck.adopt(p, "Pair")
+        p.ab()
+    if isinstance(threading.Lock(), lockcheck.LockProxy):
+        print("smoke_analysis: FAIL: lockcheck left threading patched")
+        return 1
+    report = lockcheck.cross_check(
+        lockcheck.observed_edges(), rules.lock_edges_from_source(src))
+    if report.confirmed != {("Pair._a", "Pair._b")} or not report.ok():
+        print(f"smoke_analysis: FAIL: lockcheck cross-check mismatch: "
+              f"confirmed={report.confirmed} cycles={report.cycles}")
+        return 1
+    return 0
+
+
 def main() -> int:
     from deeplearning4j_tpu.analysis.baseline import (Baseline,
                                                       default_baseline_path)
     from deeplearning4j_tpu.analysis.cli import main as jaxlint_main
-    from deeplearning4j_tpu.analysis.rules import RULES
+    from deeplearning4j_tpu.analysis.rules import RULES, RULES_BY_ID
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     pkg = os.path.join(repo, "deeplearning4j_tpu")
@@ -35,7 +74,10 @@ def main() -> int:
               f"lack a justification: {missing[:5]}")
         return 1
 
-    assert len(RULES) >= 10, "rule registry shrank below the contract"
+    assert len(RULES) >= 19, "rule registry shrank below the contract"
+    # the v2 concurrency / serving-discipline families must stay enabled
+    for rid in ("JL402", "JL403", "JL404", "JL501", "JL502", "JL503"):
+        assert rid in RULES_BY_ID, f"rule {rid} missing from the registry"
 
     rc = jaxlint_main([pkg])
     if rc != 0:
@@ -44,8 +86,12 @@ def main() -> int:
               "baseline them with a justification")
         return 1
 
+    if _lockcheck_smoke() != 0:
+        return 1
+
     print(f"smoke_analysis: OK ({len(RULES)} rules, "
-          f"{len(bl.entries)} baselined findings, 0 new)")
+          f"{len(bl.entries)} baselined findings, 0 new, "
+          f"lockcheck cross-check confirmed)")
     return 0
 
 
